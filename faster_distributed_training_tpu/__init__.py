@@ -1,0 +1,29 @@
+"""faster_distributed_training_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA/Pallas/pjit re-design of the capabilities of
+SuperbTUM/Faster-Distributed-Training (reference surveyed in SURVEY.md):
+
+- ResNet family + Transformer encoder workloads (``models/``)
+- Online natural-gradient descent (Kaldi-style low-rank inverse-Fisher
+  preconditioning) as a fully on-device optax transformation, plus
+  MADGRAD / MirrorMADGRAD and LR schedules (``optim/``)
+- mixup / learnable meta-mixup / intra-class mixup (``train/mixup.py``)
+- fused Conv+BN and MLP kernels via ``jax.custom_vjp`` with backward
+  recomputation, and Pallas TPU kernels for the hot ops (``ops/``)
+- data-parallel, fully-sharded (FSDP/ZeRO-style), tensor-parallel and
+  sequence-parallel (ring attention) execution over a ``jax.sharding.Mesh``
+  with XLA collectives over ICI/DCN (``parallel/``)
+- host input pipelines with background prefetch + device double-buffering,
+  with a native C++ decode/augment core (``data/``, ``runtime/``)
+- checkpoint/resume of full training state (params, optimizer incl. Fisher
+  factors, RNG, step), profiling, metrics, plotting (``train/``, ``utils/``)
+
+Import alias convention used throughout docs and tests::
+
+    import faster_distributed_training_tpu as fdt
+"""
+
+__version__ = "0.1.0"
+
+from faster_distributed_training_tpu import config as config  # noqa: F401
+from faster_distributed_training_tpu import prng as prng  # noqa: F401

@@ -1,0 +1,162 @@
+"""Unified configuration surface for every entry point.
+
+The reference duplicates an argparse block per script (resnet50_test.py:46-59,
+transformer_test.py:350-361, tuning/resnet50_tuning.py:33-50).  Here there is
+ONE flag surface shared by all entries, preserving the reference's flag names
+(--bs, --lr, --epoch, --alpha, --workers, --meta_learning, --distributed,
+--ngd, --resume) and adding the TPU-specific ones (--device, mesh shape,
+precision policy).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Everything a training run needs, in one picklable record."""
+
+    # -- workload ---------------------------------------------------------
+    model: str = "resnet50"           # resnet18/34/50/101/152 | transformer
+    dataset: str = "cifar10"          # cifar10 | agnews | synthetic
+    num_classes: int = 10
+
+    # -- optimization (reference flag surface) ----------------------------
+    lr: float = 0.1
+    batch_size: int = 512             # --bs
+    epochs: int = 30                  # --epoch
+    alpha: float = 0.2                # mixup Beta(alpha, alpha)
+    workers: int = 4
+    meta_learning: bool = False       # learnable per-sample mixup lambda
+    use_ngd: bool = False             # --ngd
+    resume: bool = False
+    distributed: bool = False
+    weight_decay: float = 1e-4        # tuning/resnet50_tuning.py:47
+    gamma: float = 0.2                # LR decay factor (tuning flag)
+    momentum: float = 0.9
+    clip_norm: float = 10.0           # resnet50_test.py:546
+    label_smoothing: float = 0.0
+    optimizer: str = ""               # "" = auto (ngd if use_ngd else madgrad)
+    schedule: str = ""                # "" = auto per reference pairing
+
+    # -- NGD hyperparameters (ngd_optimizer.py:9-15 hard-codes these) -----
+    ngd_rank: int = 40
+    ngd_update_period: int = 4
+    ngd_alpha: float = 4.0
+    ngd_eta: float = 0.1
+
+    # -- precision --------------------------------------------------------
+    precision: str = "bf16"           # bf16 | fp32 | fp16 (fp16 uses loss scaling)
+
+    # -- device / mesh ----------------------------------------------------
+    device: str = "auto"              # tpu | cpu | auto
+    mesh_shape: Tuple[int, ...] = ()  # () = auto: all devices on the dp axis
+    mesh_axes: Tuple[str, ...] = ("dp",)
+    fsdp: bool = False                # shard params/opt state over the dp axis
+    host_offload: bool = False        # FSDP param offload to host memory
+    remat: bool = False               # jax.checkpoint the model blocks
+
+    # -- data -------------------------------------------------------------
+    data_dir: str = "./data"
+    subset_stride: int = 1            # tuning harness uses 10
+    seq_len: int = 512                # transformer max length
+    seq_buckets: Tuple[int, ...] = (64, 128, 256, 512)
+    prefetch_depth: int = 2
+
+    # -- bookkeeping ------------------------------------------------------
+    seed: int = 123456                # resnet50_test.py:728
+    checkpoint_dir: str = "./checkpoint"
+    log_every: int = 50
+    profile: bool = False
+    plot: bool = True
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def build_parser(prog: str = "fdt") -> argparse.ArgumentParser:
+    """One argparse surface; flag names match the reference CLI."""
+    p = argparse.ArgumentParser(prog=prog, description=__doc__)
+    d = TrainConfig()
+    p.add_argument("--lr", default=d.lr, type=float, help="learning rate")
+    p.add_argument("--resume", "-r", action="store_true", help="resume from checkpoint")
+    p.add_argument("--epoch", default=d.epochs, type=int, help="number of epochs")
+    p.add_argument("--alpha", default=d.alpha, type=float, help="mixup Beta parameter")
+    p.add_argument("--bs", "--batch_size", "-b", dest="bs", default=d.batch_size,
+                   type=int, help="global batch size")
+    p.add_argument("--workers", default=d.workers, type=int, help="data loader workers")
+    p.add_argument("--meta_learning", action="store_true",
+                   help="learnable per-sample mixup lambda")
+    p.add_argument("--distributed", action="store_true", help="multi-host run")
+    p.add_argument("--ngd", action="store_true", help="natural gradient descent")
+    p.add_argument("--weight_decay", default=d.weight_decay, type=float)
+    p.add_argument("--gamma", default=d.gamma, type=float, help="LR decay factor")
+    p.add_argument("--model", default=None, type=str)
+    p.add_argument("--optimizer", default=d.optimizer, type=str,
+                   help="override: sgd|madgrad|mirror_madgrad|ngd|adamw")
+    p.add_argument("--device", default=d.device, choices=["auto", "tpu", "cpu"])
+    p.add_argument("--precision", default=d.precision, choices=["bf16", "fp32", "fp16"])
+    p.add_argument("--mesh", default="", type=str,
+                   help="mesh as axis=size pairs, e.g. 'dp=4,fsdp=2' (default: all dp)")
+    p.add_argument("--fsdp", action="store_true", help="fully-shard params/opt state")
+    p.add_argument("--host_offload", action="store_true")
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--data_dir", default=d.data_dir, type=str)
+    p.add_argument("--dataset", default=None, type=str)
+    p.add_argument("--subset_stride", default=d.subset_stride, type=int,
+                   help="take every Nth sample (tuning harness uses 10)")
+    p.add_argument("--seed", default=d.seed, type=int)
+    p.add_argument("--checkpoint_dir", default=d.checkpoint_dir, type=str)
+    p.add_argument("--profile", action="store_true", help="capture a jax.profiler trace")
+    p.add_argument("--no_plot", action="store_true")
+    return p
+
+
+def parse_mesh(spec: str) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
+    """'dp=4,fsdp=2' -> (('dp','fsdp'), (4,2)).  Empty -> ((), ())."""
+    if not spec:
+        return (), ()
+    axes, sizes = [], []
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        name = name.strip()
+        if not name or not size:
+            raise ValueError(f"bad mesh spec {spec!r}; want 'axis=size,...'")
+        axes.append(name)
+        sizes.append(int(size))
+    return tuple(axes), tuple(sizes)
+
+
+def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] = None,
+                     **overrides) -> TrainConfig:
+    base = defaults or TrainConfig()
+    axes, shape = parse_mesh(args.mesh)
+    cfg = base.replace(
+        lr=args.lr, resume=args.resume, epochs=args.epoch, alpha=args.alpha,
+        batch_size=args.bs, workers=args.workers, meta_learning=args.meta_learning,
+        distributed=args.distributed, use_ngd=args.ngd,
+        weight_decay=args.weight_decay, gamma=args.gamma,
+        optimizer=args.optimizer, device=args.device, precision=args.precision,
+        fsdp=args.fsdp, host_offload=args.host_offload, remat=args.remat,
+        data_dir=args.data_dir, subset_stride=args.subset_stride, seed=args.seed,
+        checkpoint_dir=args.checkpoint_dir, profile=args.profile,
+        plot=not args.no_plot,
+    )
+    if args.model:
+        cfg = cfg.replace(model=args.model)
+    if args.dataset:
+        cfg = cfg.replace(dataset=args.dataset)
+    if axes:
+        cfg = cfg.replace(mesh_axes=axes, mesh_shape=shape)
+    if cfg.fsdp and "fsdp" not in cfg.mesh_axes:
+        if cfg.mesh_shape != ():
+            raise ValueError(
+                f"--fsdp requires an 'fsdp' axis in --mesh, got {cfg.mesh_axes}; "
+                f"e.g. --mesh dp=2,fsdp=4")
+        # --fsdp with no explicit mesh: put every device on one fsdp axis,
+        # which is the ZeRO-3 topology (params sharded where data is sharded).
+        cfg = cfg.replace(mesh_axes=("fsdp",))
+    return cfg if not overrides else cfg.replace(**overrides)

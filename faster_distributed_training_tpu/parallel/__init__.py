@@ -1,0 +1,22 @@
+"""Distributed execution: device meshes, sharding rules, collectives.
+
+Replaces the reference's NCCL/DDP/FSDP/torchrun stack (utils.py:13-51,
+resnet50_test.py:716, transformer_test.py:387-392, run_distributed.sh) with
+XLA collectives compiled over ICI/DCN: a `jax.sharding.Mesh` plus
+NamedSharding partition specs; gradient synchronization is inserted by the
+compiler from the shardings rather than hooked into backward like DDP.
+"""
+
+from faster_distributed_training_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    make_mesh,
+    initialize_distributed,
+    local_batch_slice,
+)
+from faster_distributed_training_tpu.parallel.sharding import (  # noqa: F401
+    batch_spec,
+    replicated,
+    fsdp_partition_params,
+    shard_pytree,
+    tensor_parallel_rules,
+)

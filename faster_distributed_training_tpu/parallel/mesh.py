@@ -1,0 +1,96 @@
+"""Device-mesh bootstrap.
+
+The reference boots NCCL process groups three ways (utils.py:13-30:
+env:// rendezvous, torchrun-provided rank, shared-file rendezvous).  The TPU
+equivalent is `jax.distributed.initialize(coordinator, num_processes,
+process_id)` once per host, then ONE `Mesh` over all global devices; data /
+fully-sharded / tensor / sequence parallelism are just axes of that mesh.
+
+Axis naming convention used framework-wide:
+  "dp"   — data parallel (batch sharded, grads psum'd by XLA)
+  "fsdp" — fully-sharded data parallel (batch AND params/opt-state sharded;
+           ZeRO-3; XLA turns grad psum into reduce_scatter + all_gather)
+  "tp"   — tensor parallel (attention heads / MLP hidden sharded)
+  "sp"   — sequence/context parallel (ring attention, ops/ring_attention.py)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    axes: Tuple[str, ...]
+    shape: Tuple[int, ...]
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+
+def initialize_distributed(coordinator: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap; replaces MASTER_ADDR/MASTER_PORT + init_process_group.
+
+    No-op for single-process runs.  Arguments default from the environment
+    (FDT_COORDINATOR, FDT_NUM_PROCESSES, FDT_PROCESS_ID), mirroring how
+    torchrun feeds rank/world-size via env vars (utils.py:20-23) — but with
+    no fixed hard-coded port (reference pins 12355, utils.py:15).
+    """
+    coordinator = coordinator or os.environ.get("FDT_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("FDT_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("FDT_PROCESS_ID", "0"))
+    if num_processes > 1:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+
+
+def make_mesh(axes: Sequence[str] = ("dp",),
+              shape: Sequence[int] = (),
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh. Empty `shape` auto-sizes: one unsized axis absorbs all devices.
+
+    Examples:
+      make_mesh()                          -> all devices on "dp"
+      make_mesh(("dp","tp"), (2, 4))       -> 2x4 mesh
+      make_mesh(("fsdp",))                 -> all devices fully-sharded
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = tuple(axes)
+    if not shape:
+        shape = (n,) + (1,) * (len(axes) - 1)
+    shape = tuple(shape)
+    if len(shape) != len(axes):
+        raise ValueError(f"mesh axes {axes} vs shape {shape} rank mismatch")
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} needs {int(np.prod(shape))} devices, "
+                         f"have {n}")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axes)
+
+
+def local_batch_slice(global_batch: int, mesh: Mesh) -> Tuple[int, int]:
+    """(per-host batch, host offset) for building per-host sharded loaders.
+
+    Replaces torch's DistributedSampler (resnet50_test.py:331): each host
+    loads only its slice of the global batch; `jax.make_array_from_process_local_data`
+    assembles the global array.
+    """
+    n_proc = jax.process_count()
+    if global_batch % n_proc:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"{n_proc} processes")
+    per = global_batch // n_proc
+    return per, per * jax.process_index()

@@ -1,0 +1,43 @@
+"""Explicit PRNG handling.
+
+The reference relies on global torch RNG state (torch.manual_seed(123456),
+resnet50_test.py:728) plus host-side numpy/Beta sampling per step.  Here
+randomness is explicit and reproducible across hosts and devices: one root
+key per run, folded by purpose and step so every consumer gets an
+independent stream and the same key sequence regardless of device count.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+# Stable fold constants so streams can't collide across purposes.
+_STREAMS = ("params", "dropout", "mixup", "data", "init", "eval")
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def stream(key: jax.Array, name: str) -> jax.Array:
+    """Fold a named purpose into a key. Unknown names hash by position-independent fold."""
+    try:
+        idx = _STREAMS.index(name)
+    except ValueError:
+        # crc32, not hash(): str hash is salted per process, which would
+        # derive different keys on different hosts of the same run.
+        idx = (zlib.crc32(name.encode()) & 0x3FFFFFFF) | 0x40000000
+    return jax.random.fold_in(key, idx)
+
+
+def at_step(key: jax.Array, step) -> jax.Array:
+    """Fold a (possibly traced) step counter into a key — jit-safe."""
+    return jax.random.fold_in(key, jnp.asarray(step, dtype=jnp.uint32))
+
+
+def split_streams(key: jax.Array, *names: str) -> Dict[str, jax.Array]:
+    return {n: stream(key, n) for n in names}

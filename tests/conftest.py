@@ -1,0 +1,32 @@
+"""Test harness: force an 8-device CPU platform so every sharding/collective
+path (dp, fsdp, tp, sp/ring) is exercised without TPU hardware — the strategy
+SURVEY.md §4 prescribes (the reference has no test suite at all)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = os.environ.get("FDT_TEST_PLATFORM", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# sitecustomize may import jax before this file runs, freezing the platform
+# choice from the outer environment — override through the config API too.
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+jax.config.update("jax_threefry_partitionable", True)
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture()
+def mesh8(devices8):
+    from faster_distributed_training_tpu.parallel import make_mesh
+    return make_mesh(("dp",), (8,), devices8)
