@@ -1,0 +1,265 @@
+"""Transformer encoder for text classification, TPU-native.
+
+Re-design of the reference's transformer.py with the same architecture:
+6-layer pre-LN encoder, h=8, d_model=512, d_ff=1024 (GELU), maxlen=512,
+BERT-style 3-way embeddings (token+position+segment, transformer.py:150-156)
+*plus* an additive sinusoidal encoding (the reference adds both,
+transformer.py:61-64: ``x = embeddings + dropout(embeddings + pe)`` — a
+quirk we preserve), CLS pooler (transformer.py:94-101), sentence-embedding
+mixup inside forward (transformer.py:71-84), FusedMLP classifier
+(transformer.py:278-289), Xavier-uniform init for every >1-dim param
+(transformer.py:86-91).
+
+Deliberate fixes over the reference (SURVEY.md §7 "bugs to fix"):
+  * mixup only runs when ``train=True`` — the reference mixes at eval
+    too and its eval path then mis-unpacks the tuple
+    (transformer_test.py:321);
+  * the attention mask fills with a genuinely large negative number —
+    the reference's ``-1e-9`` (transformer.py:189) is ~0 and masks
+    nothing;
+  * the token-embedding fp32 island (transformer.py:154-155) is kept:
+    embedding tables live and are summed in fp32, then cast to the
+    compute dtype;
+  * attention can route through a Pallas flash-attention kernel
+    (``attention_impl='flash'``) instead of the O(L^2) dense softmax.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from faster_distributed_training_tpu.ops.fused_mlp import fused_mlp
+
+Dtype = Any
+NEG_INF = -1e9  # proper masking constant (reference bug: -1e-9)
+
+
+def xavier_uniform(key, shape, dtype=jnp.float32):
+    return nn.initializers.xavier_uniform()(key, shape, dtype)
+
+
+class TorchLayerNorm(nn.Module):
+    """The reference's hand-rolled LayerNorm (transformer.py:230-242):
+    (x - mean) / (std + eps) with *unbiased* std and eps added to std."""
+    eps: float = 1e-6
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d = x.shape[-1]
+        a = self.param("scale", nn.initializers.ones, (d,), self.param_dtype)
+        b = self.param("bias", nn.initializers.zeros, (d,), self.param_dtype)
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        # unbiased std (torch x.std default), eps added to std not var
+        var = jnp.sum(jnp.square(x32 - mean), axis=-1, keepdims=True) / (d - 1)
+        y = a * ((x32 - mean) / (jnp.sqrt(var) + self.eps)) + b
+        return y.astype(self.dtype)
+
+
+def sinusoidal_table(max_len: int, d_model: int) -> np.ndarray:
+    """transformer.py:116-121 — static sin/cos table, built host-side once."""
+    pe = np.zeros((max_len, d_model), dtype=np.float32)
+    position = np.arange(max_len)[:, None]
+    scale = np.exp(np.arange(0, d_model, 2) * -(math.log(10000.0) / d_model))
+    pe[:, 0::2] = np.sin(position * scale)
+    pe[:, 1::2] = np.cos(position * scale)
+    return pe
+
+
+class Embeddings(nn.Module):
+    """token + learned-position + segment embeddings, scaled by sqrt(d_model)
+    (transformer.py:132-156). Tables and the sum stay fp32 (the reference's
+    autocast-disabled island), cast to compute dtype by the caller."""
+    d_model: int
+    vocab: int
+    maxlen: int
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, token_types: jax.Array) -> jax.Array:
+        tok = self.param("token_embedding", xavier_uniform,
+                         (self.vocab, self.d_model), self.param_dtype)
+        pos = self.param("pos_embedding", xavier_uniform,
+                         (self.maxlen, self.d_model), self.param_dtype)
+        seg = self.param("segment_embedding", xavier_uniform,
+                         (3, self.d_model), self.param_dtype)
+        L = x.shape[1]
+        tokens = jnp.take(tok, x, axis=0).astype(jnp.float32)
+        positions = pos[None, :L, :].astype(jnp.float32)
+        segments = jnp.take(seg, token_types[:, :L], axis=0).astype(jnp.float32)
+        return (tokens + positions + segments) * math.sqrt(self.d_model)
+
+
+def dense_attention(q, k, v, mask, dropout_rate, deterministic, dropout_rng):
+    """ScaledDotProduct (transformer.py:180-193) with a fixed mask constant."""
+    d_k = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d_k)
+    if mask is not None:
+        scores = jnp.where(mask == 0, jnp.asarray(NEG_INF, scores.dtype), scores)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_rate > 0.0 and not deterministic:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+class MultiheadAttention(nn.Module):
+    """transformer.py:196-227 — 3 full-width projections + output proj."""
+    h: int
+    d_model: int
+    dropout: float = 0.1
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    attention_impl: str = "dense"     # dense | flash
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: Optional[jax.Array],
+                 train: bool) -> jax.Array:
+        B, L, _ = x.shape
+        d_k = self.d_model // self.h
+        dense = lambda name: nn.Dense(   # noqa: E731
+            self.d_model, kernel_init=xavier_uniform, dtype=self.dtype,
+            param_dtype=self.param_dtype, name=name)
+        q = dense("query")(x).reshape(B, L, self.h, d_k).transpose(0, 2, 1, 3)
+        k = dense("key")(x).reshape(B, L, self.h, d_k).transpose(0, 2, 1, 3)
+        v = dense("value")(x).reshape(B, L, self.h, d_k).transpose(0, 2, 1, 3)
+        if self.attention_impl == "flash":
+            from faster_distributed_training_tpu.ops.flash_attention import (
+                flash_attention)
+            ctx = flash_attention(q, k, v, mask=mask)
+        else:
+            rng = (self.make_rng("dropout")
+                   if (self.dropout > 0 and train) else None)
+            ctx = dense_attention(q, k, v, mask, self.dropout,
+                                  deterministic=not train, dropout_rng=rng)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, L, self.d_model)
+        return dense("out")(ctx)
+
+
+class PositionalWiseFFN(nn.Module):
+    """transformer.py:159-177 — Linear → GELU → dropout → Linear."""
+    d_model: int
+    d_ff: int
+    dropout: float = 0.1
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        kw = dict(kernel_init=xavier_uniform, dtype=self.dtype,
+                  param_dtype=self.param_dtype)
+        h = nn.Dense(self.d_ff, **kw)(x)
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return nn.Dense(self.d_model, **kw)(h)
+
+
+class Transformer(nn.Module):
+    """transformer.py:12-91 — returns (logits, perm_index, lam) in train mode
+    (mixup on the pooled sentence embedding), plain logits in eval mode."""
+    n_class: int
+    vocab: int = 30522            # bert-base-uncased vocab size
+    n_layers: int = 6
+    h: int = 8
+    d_model: int = 512
+    d_ff: int = 1024
+    d_hidden: int = 1024
+    maxlen: int = 512
+    dropout_encodings: float = 0.1
+    dropout_connection_attention: float = 0.1
+    dropout_connection_ffn: float = 0.1
+    dropout_attention: float = 0.1
+    dropout_ffn: float = 0.1
+    alpha: float = 0.99           # in-forward mixup Beta parameter
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    attention_impl: str = "dense"
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, token_types: Optional[jax.Array] = None,
+                 mask: Optional[jax.Array] = None, train: bool = True):
+        B, L = x.shape
+        if token_types is None:
+            token_types = jnp.zeros_like(x)
+        embeddings = Embeddings(self.d_model, self.vocab, self.maxlen,
+                                self.param_dtype)(x, token_types)
+        # x = embeddings + dropout(embeddings + pe): the reference feeds the
+        # PositionalEncoding module the embeddings and then ADDS its output to
+        # the embeddings again (transformer.py:61-64) — preserved verbatim.
+        pe = jnp.asarray(sinusoidal_table(self.maxlen, self.d_model))
+        encodings = nn.Dropout(self.dropout_encodings,
+                               deterministic=not train)(
+            embeddings + pe[None, :L, :])
+        h = (embeddings + encodings).astype(self.dtype)
+
+        if mask is not None and mask.ndim == 2:   # (B, L) padding mask
+            mask = mask[:, None, None, :]          # broadcast over heads+query
+
+        ln = lambda name: TorchLayerNorm(   # noqa: E731
+            dtype=self.dtype, param_dtype=self.param_dtype, name=name)
+        for i in range(self.n_layers):
+            # pre-LN attention sublayer (transformer.py:245-259)
+            a = ln(f"ln_attn_{i}")(h)
+            a = MultiheadAttention(self.h, self.d_model, self.dropout_attention,
+                                   self.dtype, self.param_dtype,
+                                   self.attention_impl,
+                                   name=f"attn_{i}")(a, mask, train)
+            a = nn.Dropout(self.dropout_connection_attention,
+                           deterministic=not train)(a)
+            h = h + a
+            # pre-LN FFN sublayer (transformer.py:262-275)
+            f = ln(f"ln_ffn_{i}")(h)
+            f = PositionalWiseFFN(self.d_model, self.d_ff, self.dropout_ffn,
+                                  self.dtype, self.param_dtype,
+                                  name=f"ffn_{i}")(f, train)
+            f = nn.Dropout(self.dropout_connection_ffn,
+                           deterministic=not train)(f)
+            h = h + f
+
+        # Pooler: tanh(dense(CLS)) (transformer.py:94-101)
+        pooled = nn.tanh(nn.Dense(self.d_model, kernel_init=xavier_uniform,
+                                  dtype=self.dtype,
+                                  param_dtype=self.param_dtype,
+                                  name="pooler")(h[:, 0, :]))
+        pooled = nn.Dropout(0.1, deterministic=not train)(pooled)
+
+        # FusedMLP classifier (transformer.py:278-289): d_model→d_hidden→n_class
+        w1 = self.param("cls_w1", xavier_uniform,
+                        (self.d_hidden, self.d_model), self.param_dtype)
+        b1 = self.param("cls_b1", nn.initializers.zeros,
+                        (1, self.d_hidden), self.param_dtype)
+        w2 = self.param("cls_w2", xavier_uniform,
+                        (self.n_class, self.d_hidden), self.param_dtype)
+        b2 = self.param("cls_b2", nn.initializers.zeros,
+                        (1, self.n_class), self.param_dtype)
+
+        def classify(z):
+            logits = fused_mlp(z.astype(self.dtype), w1.astype(self.dtype),
+                               b1.astype(self.dtype), w2.astype(self.dtype),
+                               b2.astype(self.dtype))
+            return logits.astype(jnp.float32)
+
+        if not train:
+            return classify(pooled)
+
+        # in-forward sentence-embedding mixup (transformer.py:71-84),
+        # gated on train — fixing the reference's always-on mixup at eval.
+        key = self.make_rng("mixup")
+        k_lam, k_perm = jax.random.split(key)
+        if self.alpha > 0:
+            lam = jax.random.beta(k_lam, self.alpha, self.alpha)
+        else:
+            lam = jnp.asarray(self.alpha, jnp.float32)
+        index = jax.random.permutation(k_perm, B)
+        mixed = (lam.astype(pooled.dtype) * pooled
+                 + (1 - lam).astype(pooled.dtype) * pooled[index])
+        return classify(mixed), index, lam

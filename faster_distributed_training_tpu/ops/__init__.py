@@ -1,0 +1,13 @@
+"""Custom kernels: fused Conv+BN, fused MLP, flash / ring attention.
+
+These are the TPU-native counterparts of the reference's hand-written
+autograd Functions (resnet.py:72-113 FusedConvBN2DFunction,
+transformer.py:292-338 MLPScratch): `jax.custom_vjp` functions with
+backward recomputation (activation rematerialization) plus Pallas TPU
+kernels for the attention hot path.
+"""
+
+from faster_distributed_training_tpu.ops.conv_bn import (  # noqa: F401
+    conv2d, fused_conv_bn, conv_bn_reference)
+from faster_distributed_training_tpu.ops.fused_mlp import (  # noqa: F401
+    fused_mlp, mlp_reference)

@@ -1,0 +1,132 @@
+"""Fused Conv2D + BatchNorm with recompute-in-backward.
+
+TPU-native re-design of the reference's ``FusedConvBN2DFunction``
+(``resnet.py:72-113``): one ``jax.custom_vjp`` primitive whose forward
+saves only ``(X, W, sum, sqrt_var)`` and whose backward *recomputes* the
+convolution output before applying a hand-derived BatchNorm backward and
+the convolution transpose — the same activation-rematerialization memory
+trick as the reference (``resnet.py:107-108``), expressed so XLA fuses
+the normalize into the conv epilogue on the MXU.
+
+Semantics matched to the reference:
+  * BN has no affine γ/β (``resnet.py:85-99``),
+  * variance is the *unbiased* estimator (``resnet.py:86``),
+  * eps is added to the *standard deviation*, not the variance
+    (``denom = sqrt_var + eps``, ``resnet.py:94``), default 1e-3.
+
+Differences (deliberate, documented per SURVEY.md §7 "bugs to fix"):
+  * layout is NHWC / HWIO (TPU-native) instead of NCHW / OIHW;
+  * any stride is supported (reference asserts stride == 1,
+    ``resnet.py:120``);
+  * the op also returns ``(mean, var)`` so callers can maintain running
+    statistics for deterministic eval — the reference uses batch stats
+    at eval time (SURVEY.md §7 hard part 2);
+  * under ``pjit`` with the batch sharded over a mesh axis, the
+    channel reductions are *global* means/vars — i.e. cross-replica
+    SyncBN falls out of the SPMD partitioner for free, unlike the
+    reference's per-GPU batch stats.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Padding = Union[str, int, Tuple[Tuple[int, int], Tuple[int, int]]]
+
+
+def _norm_padding(padding: Padding):
+    if isinstance(padding, int):
+        return ((padding, padding), (padding, padding))
+    return padding
+
+
+def conv2d(x: jax.Array, w: jax.Array, stride: int = 1,
+           padding: Padding = 1) -> jax.Array:
+    """Plain NHWC conv with HWIO kernel (maps straight onto the MXU)."""
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=(stride, stride),
+        padding=_norm_padding(padding),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _stats_dtype(dtype) -> jnp.dtype:
+    """bf16/fp16 statistics are numerically unsafe — promote to at least fp32."""
+    return jnp.promote_types(dtype, jnp.float32)
+
+
+def _bn_stats(y: jax.Array) -> Tuple[jax.Array, jax.Array, float]:
+    """(mean, unbiased var, N) over all axes but channel (last), in fp32+."""
+    n = y.size // y.shape[-1]
+    y = y.astype(_stats_dtype(y.dtype))
+    mean = jnp.mean(y, axis=(0, 1, 2))
+    # unbiased estimator, matching torch's X.var(unbiased=True) (resnet.py:86)
+    var = jnp.sum(jnp.square(y - mean), axis=(0, 1, 2)) / (n - 1)
+    return mean, var, n
+
+
+def conv_bn_reference(x: jax.Array, w: jax.Array, stride: int = 1,
+                      padding: Padding = 1, eps: float = 1e-3) -> jax.Array:
+    """Unfused conv+BN — the autodiff oracle the fused kernel is tested against."""
+    y = conv2d(x, w, stride, padding)
+    mean, var, _ = _bn_stats(y)
+    out = (y.astype(mean.dtype) - mean) / (jnp.sqrt(var) + eps)
+    return out.astype(y.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def fused_conv_bn(x: jax.Array, w: jax.Array, stride: int = 1,
+                  padding: Padding = 1, eps: float = 1e-3):
+    """Fused conv+BN. Returns ``(out, mean, var)``; ``mean``/``var`` are
+    per-channel batch statistics for the caller's running-stat update."""
+    y = conv2d(x, w, stride, padding)
+    mean, var, _ = _bn_stats(y)
+    out = ((y.astype(mean.dtype) - mean) / (jnp.sqrt(var) + eps)).astype(y.dtype)
+    return out, mean, var
+
+
+def _fused_fwd(x, w, stride, padding, eps):
+    y = conv2d(x, w, stride, padding)
+    mean, var, _ = _bn_stats(y)
+    sqrt_var = jnp.sqrt(var)
+    out = ((y.astype(mean.dtype) - mean) / (sqrt_var + eps)).astype(y.dtype)
+    # Save only (X, W, mean, sqrt_var) — NOT the conv output y, which is the
+    # big NHWC buffer. Backward recomputes it (resnet.py:107-108 parity).
+    return (out, mean, var), (x, w, mean, sqrt_var)
+
+
+def _fused_bwd(stride, padding, eps, res, cts):
+    x, w, mean, sqrt_var = res
+    g, _, _ = cts  # cotangents for (out, mean, var); stats are stats-only outputs
+
+    # (1) recompute the conv output — the rematerialization step, done through
+    # jax.vjp so the same computation also yields the conv transpose closure.
+    y, conv_vjp = jax.vjp(lambda x_, w_: conv2d(x_, w_, stride, padding), x, w)
+
+    # (2) hand-derived BatchNorm backward (matches batch_norm_backward,
+    # resnet.py:37-69, rewritten vectorized over NHWC), in fp32+:
+    #   out_i = (y_i - mu) / s,   s = sqrt(var) + eps,  var unbiased over n.
+    n = y.size // y.shape[-1]
+    sd = mean.dtype
+    y32, g32 = y.astype(sd), g.astype(sd)
+    s = sqrt_var + eps
+    centered = y32 - mean
+    g_sum = jnp.sum(g32, axis=(0, 1, 2))
+    # d var: through s = sqrt(var)+eps; note sum_i centered_i = 0 kills the
+    # mean-path inside var.
+    d_s = -jnp.sum(g32 * centered, axis=(0, 1, 2)) / (s * s)
+    d_var = d_s / (2.0 * sqrt_var)
+    dy = g32 / s + centered * (2.0 * d_var / (n - 1)) - g_sum / (s * n)
+
+    # (3) conv backward through the recomputed vjp.
+    dx, dw = conv_vjp(dy.astype(y.dtype))
+    return dx, dw
+
+
+fused_conv_bn.defvjp(_fused_fwd, _fused_bwd)

@@ -17,6 +17,8 @@ import pytest  # noqa: E402
 # choice from the outer environment — override through the config API too.
 jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 jax.config.update("jax_threefry_partitionable", True)
+# fp64 available for gradcheck-style kernel tests (explicit dtypes elsewhere).
+jax.config.update("jax_enable_x64", True)
 
 
 @pytest.fixture(scope="session")

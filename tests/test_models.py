@@ -1,0 +1,116 @@
+"""Model-zoo tests: shapes, train/eval semantics, and parameter-count parity
+with the torch reference (used read-only as an oracle, never copied)."""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from faster_distributed_training_tpu.models import (
+    Transformer, get_model, resnet18, resnet50)
+
+REFERENCE = "/root/reference"
+
+
+def _init_resnet(model, bs=2, hw=32):
+    x = jnp.zeros((bs, hw, hw, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    return variables, x
+
+
+class TestResNet:
+    def test_forward_shapes_and_dtypes(self):
+        model = resnet18(num_classes=10)
+        variables, x = _init_resnet(model)
+        logits, mutated = model.apply(variables, x, train=True,
+                                      mutable=["batch_stats"])
+        assert logits.shape == (2, 10) and logits.dtype == jnp.float32
+        assert "batch_stats" in mutated
+
+    def test_eval_deterministic_and_uses_running_stats(self):
+        model = resnet18(num_classes=10)
+        variables, _ = _init_resnet(model)
+        x1 = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+        x2 = jax.random.normal(jax.random.PRNGKey(2), (4, 32, 32, 3))
+        # eval output for a sample must not depend on its batch companions —
+        # the bug the reference has (batch-stats eval, resnet.py:83-100).
+        solo = model.apply(variables, x1, train=False)
+        paired = model.apply(variables, jnp.concatenate([x1, x2]), train=False)
+        np.testing.assert_allclose(np.asarray(solo), np.asarray(paired[:4]),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_param_count_matches_torch_reference(self):
+        torch = pytest.importorskip("torch")
+        sys.path.insert(0, REFERENCE)
+        try:
+            import resnet as ref_resnet  # noqa: F401 — reference, read-only oracle
+        except Exception as e:  # pragma: no cover
+            pytest.skip(f"reference not importable: {e}")
+        finally:
+            sys.path.pop(0)
+        ref = ref_resnet.resnet50(num_classes=10)
+        ref_count = sum(p.numel() for p in ref.parameters())
+        model = resnet50(num_classes=10)
+        variables, _ = _init_resnet(model)
+        ours = sum(np.prod(np.shape(p))
+                   for p in jax.tree.leaves(variables["params"]))
+        assert int(ours) == int(ref_count), (ours, ref_count)
+
+    def test_bf16_compute(self):
+        model = resnet18(num_classes=10, dtype=jnp.bfloat16)
+        variables, x = _init_resnet(model)
+        logits, _ = model.apply(variables, x, train=True,
+                                mutable=["batch_stats"])
+        assert logits.dtype == jnp.float32  # fp32 logits island
+        assert np.isfinite(np.asarray(logits)).all()
+
+
+class TestTransformer:
+    @pytest.fixture(scope="class")
+    def small(self):
+        model = Transformer(n_class=4, vocab=100, n_layers=2, h=4, d_model=32,
+                            d_ff=64, d_hidden=64, maxlen=16)
+        x = jnp.ones((4, 12), jnp.int32)
+        variables = model.init(
+            {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1),
+             "mixup": jax.random.PRNGKey(2)}, x, train=False)
+        return model, variables, x
+
+    def test_train_returns_mixup_triplet(self, small):
+        model, variables, x = small
+        out = model.apply(variables, x, train=True,
+                          rngs={"dropout": jax.random.PRNGKey(3),
+                                "mixup": jax.random.PRNGKey(4)})
+        logits, index, lam = out
+        assert logits.shape == (4, 4)
+        assert index.shape == (4,)
+        assert 0.0 <= float(lam) <= 1.0
+
+    def test_eval_returns_plain_logits(self, small):
+        # fixes the reference bug: eval path also produced the tuple
+        # (transformer_test.py:321) and kept mixing (transformer.py:71-84).
+        model, variables, x = small
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (4, 4)
+        out2 = model.apply(variables, x, train=False)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+    def test_padding_mask_blocks_attention(self, small):
+        model, variables, _ = small
+        x = jnp.ones((2, 8), jnp.int32)
+        mask = jnp.ones((2, 8), jnp.int32).at[:, 4:].set(0)
+        a = model.apply(variables, x, mask=mask, train=False)
+        # changing masked-out tokens must not change the logits
+        x2 = x.at[:, 4:].set(7)
+        b = model.apply(variables, x2, mask=mask, train=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_factory(self):
+        m = get_model("transformer", 4, vocab=50, n_layers=1, h=2, d_model=16,
+                      d_ff=32, d_hidden=32, maxlen=8)
+        assert isinstance(m, Transformer)
+        with pytest.raises(ValueError):
+            get_model("alexnet", 10)
