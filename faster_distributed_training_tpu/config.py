@@ -43,7 +43,7 @@ class TrainConfig:
     schedule: str = ""                # "" = auto per reference pairing
 
     # -- NGD hyperparameters (ngd_optimizer.py:9-15 hard-codes these) -----
-    ngd_rank: int = 40
+    ngd_rank: int = -1                # -1 = auto: min((dim+1)//2, 80) per axis
     ngd_update_period: int = 4
     ngd_alpha: float = 4.0
     ngd_eta: float = 0.1

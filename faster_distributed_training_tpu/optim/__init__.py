@@ -1,0 +1,24 @@
+"""Optimizers and schedules.
+
+The reference pairs (resnet50_test.py:486-494, transformer_test.py:216-226,
+tuning/resnet50_tuning.py:431-440):
+  * --ngd        → NGD(momentum .9, wd 1e-4) + MultiStepLR([10,20], 0.2)
+  * resnet else  → MADGRAD + CosineAnnealingLR(T_max=200)
+  * transformer  → NGD or MirrorMADGRAD + OneCycleLR(max_lr=5*lr)
+  * tuning       → NGD + StepLR(2, gamma) or SGD + CosineAnnealing
+
+Everything here is a pure optax GradientTransformation whose state lives
+on device (the reference's NGD round-trips to host for every Fisher
+update, ngd_optimizer.py:225,240,265,285-289 — the #1 perf hazard
+SURVEY.md §7 flags).
+"""
+
+from faster_distributed_training_tpu.optim.ngd import (  # noqa: F401
+    NGDHyperParams, OnlineNaturalGradientState, init_ng_state, ngd,
+    precondition, scale_by_ngd)
+from faster_distributed_training_tpu.optim.madgrad import (  # noqa: F401
+    madgrad, mirror_madgrad)
+from faster_distributed_training_tpu.optim.schedules import (  # noqa: F401
+    cosine_annealing, multistep, one_cycle, step_decay)
+from faster_distributed_training_tpu.optim.builder import (  # noqa: F401
+    build_optimizer)

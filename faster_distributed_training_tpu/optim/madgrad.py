@@ -1,0 +1,102 @@
+"""MADGRAD and MirrorMADGRAD as optax transformations.
+
+The reference consumes these from the external ``madgrad`` CUDA-ready
+package (``resnet50_test.py:493``, ``transformer_test.py:220``).  Here
+they are pure JAX, following Defazio & Jelassi, *Adaptivity without
+Compromise* (MADGRAD), and the mirror-descent variant from the same
+repository.
+
+Per step k (0-based), with lr λ, momentum c_m, eps:
+    lamb_k = λ * sqrt(k+1)
+    s_{k+1} = s_k + lamb_k * g            (dual average of gradients)
+    v_{k+1} = v_k + lamb_k * g^2          (dual average of squares)
+    z_{k+1} = x_0 - s_{k+1} / (v_{k+1}^{1/3} + eps)
+    x_{k+1} = (1 - c) x_k + c z_{k+1},    c = 1 - c_m
+
+MirrorMADGRAD replaces the dual-averaging point x_0 with a mirror-descent
+step on z itself:
+    z_{k+1} = z_k - lamb_k * g / (v_{k+1}^{1/3} + eps)
+    x_{k+1} = (1 - c) x_k + c z_{k+1}
+
+Weight decay is L2 (added to the gradient), matching the package default.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class MadgradState(NamedTuple):
+    step: jax.Array   # () int32
+    s: optax.Updates  # gradient dual average (MADGRAD) — unused by mirror
+    v: optax.Updates  # squared-gradient dual average
+    z: optax.Updates  # x_0 copy (MADGRAD) or mirror point (MirrorMADGRAD)
+
+
+def _tree_zeros_like(params):
+    return jax.tree.map(jnp.zeros_like, params)
+
+
+def _make(learning_rate, momentum, weight_decay, eps, mirror: bool
+          ) -> optax.GradientTransformation:
+    if not 0.0 <= momentum < 1.0:
+        raise ValueError(f"momentum {momentum} must be in [0, 1)")
+
+    def init_fn(params):
+        return MadgradState(
+            step=jnp.asarray(0, jnp.int32),
+            s=_tree_zeros_like(params),
+            v=_tree_zeros_like(params),
+            z=jax.tree.map(jnp.copy, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("madgrad requires params")
+        lr = (learning_rate(state.step) if callable(learning_rate)
+              else learning_rate)
+        k = state.step.astype(jnp.float32)
+        lamb = lr * jnp.sqrt(k + 1.0)
+        ck = 1.0 - momentum
+
+        if weight_decay:
+            updates = jax.tree.map(lambda g, p: g + weight_decay * p,
+                                   updates, params)
+
+        v_new = jax.tree.map(lambda v, g: v + lamb * g * g, state.v, updates)
+        if mirror:
+            z_new = jax.tree.map(
+                lambda z, g, v: z - lamb * g / (jnp.cbrt(v) + eps),
+                state.z, updates, v_new)
+            s_new = state.s
+        else:
+            s_new = jax.tree.map(lambda s, g: s + lamb * g, state.s, updates)
+            z_new = state.z  # x_0, never changes
+        # x_{k+1} = (1-c) x_k + c z_{k+1}; emit the delta for optax
+        if mirror:
+            def delta(p, z):
+                return ck * (z - p)
+            new_updates = jax.tree.map(delta, params, z_new)
+        else:
+            def delta(p, z0, s, v):
+                z = z0 - s / (jnp.cbrt(v) + eps)
+                return ck * (z - p)
+            new_updates = jax.tree.map(delta, params, z_new, s_new, v_new)
+        return new_updates, MadgradState(state.step + 1, s_new, v_new, z_new)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def madgrad(learning_rate, momentum: float = 0.9, weight_decay: float = 0.0,
+            eps: float = 1e-6) -> optax.GradientTransformation:
+    return _make(learning_rate, momentum, weight_decay, eps, mirror=False)
+
+
+def mirror_madgrad(learning_rate, momentum: float = 0.9,
+                   weight_decay: float = 0.0,
+                   eps: float = 1e-6) -> optax.GradientTransformation:
+    return _make(learning_rate, momentum, weight_decay, eps, mirror=True)

@@ -1,0 +1,338 @@
+"""Online natural-gradient descent, fully on device.
+
+TPU-native re-design of the reference's ``OnlineNaturalGradient`` /
+``NGD`` (``ngd_optimizer.py``, itself a Python port of Kaldi's
+natural-gradient-online.cc).  The algorithm: per parameter tensor and per
+tensor axis, maintain a rank-R-plus-identity approximation of that
+axis's Fisher matrix,
+
+    F_t ≈ W_t^T diag(d_t) W_t + rho_t I          (dim x dim, R << dim)
+
+and precondition each incoming gradient by (approximately) F_t^{-1},
+then rescale so the preconditioned gradient keeps the Euclidean norm of
+the raw gradient (``ngd_optimizer.py:151-168``).  Every
+``update_period`` steps (and always in the first 10) the factorization
+is refreshed from the current minibatch of directions via a rank-sized
+symmetric eigendecomposition (``ngd_optimizer.py:205-328``).
+
+What is deliberately different from the reference (SURVEY.md §7 hard
+part 1 — this is the point of the TPU build):
+
+  * **No host round-trips.**  The reference calls ``.item()`` on five
+    scalars per update and runs ``eigh`` on CPU
+    (``ngd_optimizer.py:225,240,265,285-289``), forcing a device sync
+    per parameter-axis per step.  Here the entire update — including the
+    (R,R) ``eigh`` with R <= 80 — is traced into the jitted train step.
+  * **State is an optax pytree** (one ``OnlineNaturalGradientState`` per
+    preconditioned axis), so it is shardable under pjit, checkpointable
+    by orbax (the reference never serializes Fisher state — SURVEY §5),
+    and donate-able.
+  * **Update gating via ``lax.cond``** on the step counter, so the
+    expensive refresh is only *executed* every ``update_period`` steps
+    even inside one compiled graph.
+  * **NaN fallback preserves state**: on a non-finite result the
+    reference returns the raw gradient but keeps possibly-poisoned
+    factors (``ngd_optimizer.py:158-165``); we also roll back W/d/rho.
+
+Hyperparameters match ``ngd_optimizer.py:9-15``: alpha=4.0,
+rank=min((dim+1)//2, 80), update_period=4, eta=0.1, epsilon=1e-10,
+delta=5e-4; preconditioning is a no-op for axes of dim 1
+(``ngd_optimizer.py:110-111``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Tuple
+
+import chex
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+EPSILON = 1.0e-10
+DELTA = 5.0e-4
+NUM_INITIAL_ITERS = 10  # always update during the first 10 steps
+
+
+@dataclasses.dataclass(frozen=True)
+class NGDHyperParams:
+    alpha: float = 4.0
+    rank: int = -1          # -1 → min((dim+1)//2, 80) per axis
+    update_period: int = 4
+    eta: float = 0.1
+
+
+class OnlineNaturalGradientState(NamedTuple):
+    """Fisher factor state for ONE tensor axis (all on device)."""
+    w: jax.Array     # (rank, dim) — inverse-Fisher factor W_t
+    d: jax.Array     # (rank,)     — eigenvalue diagonal D_t
+    rho: jax.Array   # ()          — identity scale rho_t
+    t: jax.Array     # () int32    — number of precondition calls
+
+
+def _default_rank(dim: int, rank: int) -> int:
+    if rank > 0:
+        # The reference asserts 0 < rank < dim per axis (ngd_optimizer.py:25)
+        # which would make one global rank setting crash on small axes; we
+        # clamp instead so e.g. rank=40 still works on a dim-3 kernel axis.
+        return min(rank, dim - 1)
+    return min((dim + 1) // 2, 80)
+
+
+def _orthonormal_special(rank: int, dim: int) -> np.ndarray:
+    """Deterministic near-orthonormal (rank, dim) matrix
+    (ngd_optimizer.py:397-420), built host-side — it depends only on the
+    static shapes, so under jit it is a compile-time constant."""
+    first_elem = 1.1
+    num_cols = dim // rank
+    remainder = dim % rank
+    k = np.full((rank,), 1.0 / np.sqrt(first_elem * first_elem + num_cols - 1))
+    k[:remainder] = 1.0 / np.sqrt(first_elem * first_elem + num_cols)
+    diag = np.diag(k)
+    ans = np.concatenate([np.diag(k * first_elem)]
+                         + [diag] * (num_cols + 1), axis=1)[:, :dim]
+    return ans
+
+
+def init_ng_state(dim: int, hp: NGDHyperParams,
+                  dtype=jnp.float32) -> OnlineNaturalGradientState:
+    """Default-initialized state (ngd_optimizer.py:378-395); the data-dependent
+    power-iteration warmup happens lazily at the first precondition call."""
+    rank = _default_rank(dim, hp.rank)
+    e_tii = 1.0 / (2.0 + (dim + rank) * hp.alpha / dim)
+    w0 = np.sqrt(e_tii) * _orthonormal_special(rank, dim)
+    return OnlineNaturalGradientState(
+        w=jnp.asarray(w0, dtype),
+        d=jnp.full((rank,), EPSILON, dtype),
+        rho=jnp.asarray(EPSILON, dtype),
+        t=jnp.asarray(0, jnp.int32),
+    )
+
+
+def _core_step(w, d, rho, x, tr_xxt, updating, hp: NGDHyperParams):
+    """One preconditioning step on a (N, dim) matrix of directions; returns
+    ((w', d', rho'), x_hat).  Mirrors _precondition_directions3
+    (ngd_optimizer.py:170-328) with every scalar kept on device."""
+    n_rows, dim = x.shape
+    rank = w.shape[0]
+    alpha, eta = hp.alpha, hp.eta
+    eta_n = eta / n_rows
+
+    h = x @ w.T                       # H_t = X_t W_t^T           (N, rank)
+    x_hat = x - h @ w                 # X_hat_t = X_t - H_t W_t
+
+    def no_update(_):
+        return w, d, rho
+
+    def do_update(_):
+        j = h.T @ x                   # J_t = H_t^T X_t          (rank, dim)
+        if n_rows > dim:              # static shape choice (ngd:214-217)
+            l_mat = j @ w.T
+        else:
+            l_mat = h.T @ h
+        k_mat = j @ j.T
+
+        d_sum = jnp.sum(d)
+        beta = rho * (1.0 + alpha) + alpha * d_sum / dim
+        e = 1.0 / (beta / d + 1.0)
+        inv_sqrt_e = 1.0 / jnp.sqrt(e)
+        # z_t_scale keeps Z_t (4th-power-of-gradients) in range (ngd:240)
+        z_scale = jnp.maximum(1.0, jnp.trace(k_mat))
+        d_plus_rho = d + rho
+        inv_sqrt_e_outer = ((eta_n ** 2) / z_scale) * jnp.outer(inv_sqrt_e,
+                                                                inv_sqrt_e)
+        op1 = (eta_n * (1.0 - eta) / z_scale) * jnp.outer(
+            inv_sqrt_e, inv_sqrt_e * d_plus_rho)
+        z = (k_mat * inv_sqrt_e_outer + l_mat * (op1 + op1.T)
+             + jnp.diag(((1.0 - eta) ** 2 / z_scale)
+                        * d_plus_rho * d_plus_rho))
+
+        # (rank, rank) symmetric eigendecomposition ON DEVICE — the
+        # reference ships Z_t to the CPU here (ngd_optimizer.py:265).
+        # Symmetrize first: K/L are symmetric only up to rounding, and eigh
+        # reads a single triangle.
+        z = 0.5 * (z + z.T)
+        c, u = jnp.linalg.eigh(z)
+        c = c[::-1]                    # descending
+        u = u[:, ::-1]
+        c_floor = ((rho * (1.0 - eta)) ** 2) / z_scale
+        c = jnp.maximum(c, c_floor)
+        sqrt_c = jnp.sqrt(c) * jnp.sqrt(z_scale)
+        inv_sqrt_c = 1.0 / sqrt_c
+
+        rho_new = (1.0 / (dim - rank)) * (
+            eta_n * tr_xxt + (1.0 - eta) * (dim * rho + d_sum)
+            - jnp.sum(sqrt_c))
+        floor_val = jnp.maximum(EPSILON, DELTA * jnp.max(sqrt_c))
+        d_new = jnp.maximum(sqrt_c - rho_new, floor_val)
+        rho_new = jnp.maximum(rho_new, floor_val)
+
+        beta_new = rho_new * (1.0 + alpha) + alpha * jnp.sum(d_new) / dim
+        e_new = 1.0 / (beta_new / d_new + 1.0)
+        sqrt_e_new = jnp.sqrt(e_new)
+
+        # B_t = J_t + (1-eta)/(eta/N) (D_t + rho_t I) W_t   (ngd:308-311)
+        w_coeff = ((1.0 - eta) / eta_n) * d_plus_rho
+        b = j + w_coeff[:, None] * w
+        # A_t = (eta/N) E_{t+1}^{1/2} C_t^{-1/2} U_t^T E_t^{-1/2}
+        a = u.T * jnp.outer(eta_n * sqrt_e_new * inv_sqrt_c, inv_sqrt_e)
+        return a @ b, d_new, rho_new
+
+    w1, d1, rho1 = lax.cond(updating, do_update, no_update, operand=None)
+    return (w1, d1, rho1), x_hat
+
+
+def _precondition_2d(state: OnlineNaturalGradientState, x: jax.Array,
+                     hp: NGDHyperParams
+                     ) -> Tuple[OnlineNaturalGradientState, jax.Array]:
+    """Precondition a (N, dim) matrix; full semantics of
+    _precondition_directions2 (ngd_optimizer.py:138-168) including lazy
+    power-iteration init, norm-preserving rescale and NaN fallback."""
+    dim = x.shape[1]
+    rank = state.w.shape[0]
+
+    # Lazy init (ngd_optimizer.py:356-376): at t==0, reset to the default
+    # factors then run 3 discarded updates on this same minibatch — a cheap
+    # power-iteration approximation of an SVD init.
+    def init_branch(carry):
+        del carry
+        fresh = init_ng_state(dim, dataclasses.replace(hp, rank=rank),
+                              x.dtype)
+        def body(_, wdr):
+            (w, d, rho), _x = _core_step(*wdr, x, tr_xxt, True, hp)
+            return (w, d, rho)
+        return lax.fori_loop(0, 3, body, (fresh.w, fresh.d, fresh.rho))
+
+    def carry_branch(carry):
+        return carry
+
+    tr_xxt = jnp.sum(x * x)
+    w, d, rho = lax.cond(state.t == 0, init_branch, carry_branch,
+                         (state.w, state.d, state.rho))
+
+    updating = jnp.logical_or(state.t < NUM_INITIAL_ITERS,
+                              state.t % hp.update_period == 0)
+    (w1, d1, rho1), x_hat = _core_step(w, d, rho, x, tr_xxt, updating, hp)
+
+    final = jnp.sum(x_hat * x_hat)
+    good = jnp.isfinite(final)
+    # norm-preserving rescale (ngd:168); on NaN return raw grads AND roll
+    # back the factors (improvement over ngd:158-165 which keeps them).
+    out = jnp.where(good, x_hat * jnp.sqrt(tr_xxt / (final + 1.0e-30)), x)
+    w1 = jnp.where(good, w1, w)
+    d1 = jnp.where(good, d1, d)
+    rho1 = jnp.where(good, rho1, rho)
+    return OnlineNaturalGradientState(w1, d1, rho1, state.t + 1), out
+
+
+def precondition(state: OnlineNaturalGradientState, grad: jax.Array,
+                 axis: int, hp: NGDHyperParams
+                 ) -> Tuple[OnlineNaturalGradientState, jax.Array]:
+    """Precondition `grad` along `axis` (ngd_optimizer.py:102-118): move the
+    axis last, flatten the rest, run the 2-D core, restore the layout."""
+    dim = grad.shape[axis]
+    if dim == 1:
+        return state, grad
+    moved = jnp.moveaxis(grad, axis, -1)
+    flat = moved.reshape(-1, dim)
+    state, out = _precondition_2d(state, flat, hp)
+    return state, jnp.moveaxis(out.reshape(moved.shape), -1, axis)
+
+
+# ---------------------------------------------------------------------------
+# optax wiring
+# ---------------------------------------------------------------------------
+
+
+class ScaleByNGDState(NamedTuple):
+    # pytree-of-pytrees: for each param leaf, a tuple with one
+    # OnlineNaturalGradientState per preconditioned axis (None markers are
+    # encoded as dim-1 no-op states to keep the tree static).
+    axes: Any
+
+
+def _param_axis_states(p: jax.Array, hp: NGDHyperParams, dtype
+                       ) -> Tuple[Optional[OnlineNaturalGradientState], ...]:
+    states = []
+    for axis in range(p.ndim):
+        dim = p.shape[axis]
+        if dim > 1:
+            states.append(init_ng_state(dim, hp, dtype))
+        else:
+            states.append(None)
+    return tuple(states)
+
+
+def scale_by_ngd(alpha: float = 4.0, rank: int = -1, update_period: int = 4,
+                 eta: float = 0.1, precond_dtype=jnp.float32
+                 ) -> optax.GradientTransformation:
+    """The preconditioning stage of the reference's NGD.step
+    (ngd_optimizer.py:481-491): per param, per axis with dim>1, apply the
+    online natural gradient sequentially (axis 0, then 1, ...)."""
+    hp = NGDHyperParams(alpha=alpha, rank=rank, update_period=update_period,
+                        eta=eta)
+
+    def init_fn(params):
+        axes = jax.tree.map(
+            lambda p: _param_axis_states(p, hp, precond_dtype), params,
+            is_leaf=lambda x: isinstance(x, jax.Array) or hasattr(x, "shape"))
+        return ScaleByNGDState(axes=axes)
+
+    def _is_state_tuple(x):
+        return isinstance(x, tuple) and (
+            len(x) == 0 or x[0] is None
+            or isinstance(x[0], OnlineNaturalGradientState))
+
+    def update_fn(updates, state, params=None):
+        del params
+
+        def per_leaf(g, ax_states):
+            orig_dtype = g.dtype
+            g = g.astype(precond_dtype)
+            new_states = []
+            for axis, st in enumerate(ax_states):
+                if st is None:
+                    new_states.append(None)
+                    continue
+                st, g = precondition(st, g, axis, hp)
+                new_states.append(st)
+            return g.astype(orig_dtype), tuple(new_states)
+
+        flat_updates, treedef = jax.tree.flatten(updates)
+        flat_axes = treedef.flatten_up_to(state.axes)
+        out = [per_leaf(g, ax) for g, ax in zip(flat_updates, flat_axes)]
+        new_updates = treedef.unflatten([o[0] for o in out])
+        new_axes = treedef.unflatten([o[1] for o in out])
+        return new_updates, ScaleByNGDState(axes=new_axes)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def ngd(learning_rate, momentum: float = 0.0, dampening: float = 0.0,
+        weight_decay: float = 0.0, nesterov: bool = False,
+        use_ngd: bool = True, alpha: float = 4.0, rank: int = -1,
+        update_period: int = 4, eta: float = 0.1,
+        precond_dtype=jnp.float32) -> optax.GradientTransformation:
+    """Full NGD optimizer, matching NGD.step order (ngd_optimizer.py:452-508):
+    weight decay → per-axis preconditioning → momentum/nesterov → -lr."""
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError("Nesterov momentum requires a momentum and zero "
+                         "dampening")
+    chain = []
+    if weight_decay:
+        chain.append(optax.add_decayed_weights(weight_decay))
+    if use_ngd:
+        chain.append(scale_by_ngd(alpha, rank, update_period, eta,
+                                  precond_dtype))
+    if momentum:
+        # torch SGD momentum: buf = momentum*buf + (1-dampening)*g;
+        # nesterov: d_p = g + momentum*buf — optax.trace matches.
+        chain.append(optax.trace(decay=momentum, nesterov=nesterov))
+        if dampening:
+            # optax.trace has no dampening; emulate by scaling the update in.
+            raise NotImplementedError("dampening != 0 is not supported")
+    chain.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*chain)

@@ -1,0 +1,56 @@
+"""LR schedules matching the reference pairings, as optax schedules.
+
+The reference steps its torch schedulers once per *epoch*
+(resnet50_test.py:628, transformer_test.py:291); optax schedules are
+functions of the *update step*, so every constructor here takes
+``steps_per_epoch`` and quantizes internally — same trajectory, no
+per-epoch host intervention.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import optax
+
+
+def multistep(base_lr: float, milestones: Sequence[int] = (10, 20),
+              gamma: float = 0.2, steps_per_epoch: int = 1) -> optax.Schedule:
+    """MultiStepLR([10,20], 0.2) — resnet50_test.py:489."""
+    boundaries = {int(m) * steps_per_epoch: gamma for m in milestones}
+    return optax.piecewise_constant_schedule(base_lr, boundaries)
+
+
+def cosine_annealing(base_lr: float, t_max: int = 200,
+                     steps_per_epoch: int = 1,
+                     eta_min: float = 0.0) -> optax.Schedule:
+    """CosineAnnealingLR(T_max=200) — resnet50_test.py:494."""
+    def schedule(step):
+        epoch = step / steps_per_epoch
+        return eta_min + (base_lr - eta_min) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * jnp.minimum(epoch, t_max) / t_max))
+    return schedule
+
+
+def one_cycle(base_lr: float, epochs: int, steps_per_epoch: int,
+              max_lr_factor: float = 5.0, pct_start: float = 0.3,
+              div_factor: float = 25.0,
+              final_div_factor: float = 1e4) -> optax.Schedule:
+    """OneCycleLR(max_lr=5*lr) — transformer_test.py:224-226.  The reference
+    (incorrectly) steps OneCycle per epoch with total_steps=epochs; we spread
+    the same cycle over all update steps, which is the scheduler's intent."""
+    total = max(1, epochs * steps_per_epoch)
+    return optax.cosine_onecycle_schedule(
+        transition_steps=total, peak_value=base_lr * max_lr_factor,
+        pct_start=pct_start, div_factor=div_factor,
+        final_div_factor=final_div_factor)
+
+
+def step_decay(base_lr: float, step_size: int = 2, gamma: float = 0.2,
+               steps_per_epoch: int = 1) -> optax.Schedule:
+    """StepLR(2, gamma) — tuning/resnet50_tuning.py:435."""
+    def schedule(step):
+        epoch = step // steps_per_epoch
+        return base_lr * gamma ** (epoch // step_size)
+    return schedule
