@@ -31,6 +31,7 @@ class TrainConfig:
     alpha: float = 0.2                # mixup Beta(alpha, alpha)
     workers: int = 4
     meta_learning: bool = False       # learnable per-sample mixup lambda
+    mixup_mode: str = ""              # "" auto | static | intra | meta | attn | none
     use_ngd: bool = False             # --ngd
     resume: bool = False
     distributed: bool = False

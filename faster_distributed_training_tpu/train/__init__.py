@@ -1,0 +1,16 @@
+"""Training layer: mixup family, losses, precision policy, steps, loop,
+checkpointing — the TPU re-design of the reference's train()/test() loops
+(resnet50_test.py:506-677, transformer_test.py:205-347)."""
+
+from faster_distributed_training_tpu.train.mixup import (  # noqa: F401
+    mixup_data, mixup_criterion, mixup_criterion_meta, meta_mixup_apply,
+    attn_mixup_apply, init_meta_lambda, init_attn_lambda, sample_lam)
+from faster_distributed_training_tpu.train.losses import (  # noqa: F401
+    cross_entropy, per_sample_cross_entropy)
+from faster_distributed_training_tpu.train.amp import (  # noqa: F401
+    LossScaleState, fresh_loss_scale, scale_loss, unscale_and_check,
+    update_loss_scale)
+from faster_distributed_training_tpu.train.state import (  # noqa: F401
+    TrainState, create_train_state)
+from faster_distributed_training_tpu.train.steps import (  # noqa: F401
+    make_eval_step, make_train_step)

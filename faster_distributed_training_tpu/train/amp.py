@@ -1,0 +1,67 @@
+"""Mixed precision policy + dynamic loss scaling.
+
+On TPU the primary policy is pure bf16 compute with fp32 params/stats —
+no gradient scaler needed (bf16 has fp32's exponent range).  This
+replaces the reference's fp16 autocast + GradScaler machinery
+(resnet50_test.py:533-548) and the Apex O1 fallback
+(resnet50_test.py:569-593).
+
+For parity experiments an fp16 mode with a torch-GradScaler-compatible
+*dynamic loss scale* is provided: scale the loss, unscale the grads,
+skip the step and halve the scale on non-finite grads, double the scale
+after ``growth_interval`` consecutive good steps — the exact GradScaler
+policy, but as a pure pytree inside the jitted step (no host sync)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+COMPUTE_DTYPES = {"bf16": jnp.bfloat16, "fp16": jnp.float16,
+                  "fp32": jnp.float32}
+
+
+class LossScaleState(NamedTuple):
+    scale: jax.Array          # () f32 — current loss scale
+    growth_count: jax.Array   # () i32 — consecutive finite steps
+
+
+def fresh_loss_scale(init_scale: float = 2.0 ** 16) -> LossScaleState:
+    return LossScaleState(scale=jnp.asarray(init_scale, jnp.float32),
+                          growth_count=jnp.asarray(0, jnp.int32))
+
+
+def scale_loss(loss: jax.Array, state: LossScaleState,
+               enabled: bool) -> jax.Array:
+    return loss * state.scale if enabled else loss
+
+
+def unscale_and_check(grads, state: LossScaleState, enabled: bool
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Returns (unscaled_grads, grads_finite)."""
+    if not enabled:
+        return grads, jnp.asarray(True)
+    inv = 1.0 / state.scale
+    grads = jax.tree.map(lambda g: g * inv, grads)
+    finite = jnp.all(jnp.stack(
+        [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]))
+    return grads, finite
+
+
+def update_loss_scale(state: LossScaleState, grads_finite: jax.Array,
+                      enabled: bool, growth_factor: float = 2.0,
+                      backoff_factor: float = 0.5,
+                      growth_interval: int = 2000) -> LossScaleState:
+    if not enabled:
+        return state
+    grew = state.growth_count + 1 >= growth_interval
+    new_scale = jnp.where(
+        grads_finite,
+        jnp.where(grew, state.scale * growth_factor, state.scale),
+        state.scale * backoff_factor)
+    new_count = jnp.where(grads_finite,
+                          jnp.where(grew, 0, state.growth_count + 1), 0)
+    return LossScaleState(scale=new_scale,
+                          growth_count=new_count.astype(jnp.int32))

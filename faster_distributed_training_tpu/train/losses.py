@@ -1,0 +1,25 @@
+"""Cross-entropy losses (the reference uses nn.CrossEntropyLoss throughout)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def per_sample_cross_entropy(logits: jax.Array, labels: jax.Array,
+                             label_smoothing: float = 0.0) -> jax.Array:
+    """(batch,) losses — the reduction='none' path (resnet50_test.py:456)."""
+    logits = logits.astype(jnp.float32)
+    if label_smoothing:
+        n = logits.shape[-1]
+        targets = optax.smooth_labels(jax.nn.one_hot(labels, n),
+                                      label_smoothing)
+        return optax.softmax_cross_entropy(logits, targets)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array,
+                  label_smoothing: float = 0.0) -> jax.Array:
+    """Mean-reduced CE, matching torch's default reduction."""
+    return jnp.mean(per_sample_cross_entropy(logits, labels, label_smoothing))

@@ -1,0 +1,46 @@
+"""Metric accumulation.
+
+The reference accumulates loss/correct/total on device and all-reduces
+at epoch end (resnet50_test.py:550-558,616-619).  Here per-step metrics
+are already global (jit over the sharded batch psums them), so the
+accumulator only sums device scalars and converts once per epoch —
+one host sync per epoch, not per batch."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+Metrics = Dict[str, jax.Array]
+
+
+class MetricAccumulator:
+    def __init__(self):
+        self._sums: Dict[str, List[jax.Array]] = {}
+
+    def add(self, metrics: Metrics) -> None:
+        for k, v in metrics.items():
+            self._sums.setdefault(k, []).append(v)
+
+    def summary(self) -> Dict[str, float]:
+        """One device->host sync for the whole epoch."""
+        out = {}
+        vals = {k: np.asarray(jax.device_get(v)) for k, v in self._sums.items()}
+        n_steps = max(len(v) for v in vals.values()) if vals else 0
+        for k, arr in vals.items():
+            out[k + "_sum"] = float(arr.sum())
+        if "loss" in vals and n_steps:
+            out["loss"] = float(vals["loss"].mean())
+        if "correct" in vals and "total" in vals:
+            total = float(vals["total"].sum())
+            out["accuracy"] = (float(vals["correct"].sum()) / total
+                               if total else 0.0)
+        return out
+
+    def last(self) -> Metrics:
+        return {k: v[-1] for k, v in self._sums.items()}
+
+    def reset(self) -> None:
+        self._sums.clear()

@@ -1,0 +1,105 @@
+"""The mixup family, as pure functions of an explicit PRNG key.
+
+Re-design of resnet50_test.py:355-457:
+  * ``mixup_data``       — static mixup, one Beta(alpha,alpha) lambda per
+                           batch (resnet50_test.py:355-376), with the
+                           ``intra_only`` same-class variant;
+  * ``meta_mixup_apply`` — learnable per-sample lambda
+                           (resnet50_test.py:388-401).  The reference
+                           re-instantiates the module every batch so its
+                           lambda NEVER trains (resnet50_test.py:525 —
+                           SURVEY.md §2 flags it); here the lambda is a
+                           genuine parameter leaf the caller owns and
+                           passes through the optimizer, so it trains;
+  * ``attn_mixup_apply`` — attention-map mixup: a per-pixel lambda map
+                           (resnet50_test.py:404-424);
+  * the paired criteria (resnet50_test.py:451-457).
+
+All shapes are NHWC (TPU layout); lambda broadcast shapes follow.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_lam(key: jax.Array, alpha: float) -> jax.Array:
+    """lambda ~ Beta(alpha, alpha) when alpha > 0, else the constant alpha
+    (resnet50_test.py:357-361)."""
+    if alpha > 0:
+        return jax.random.beta(key, alpha, alpha)
+    return jnp.asarray(alpha, jnp.float32)
+
+
+def mixup_data(key: jax.Array, x: jax.Array, y: jax.Array, alpha: float = 0.99,
+               intra_only: bool = False
+               ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Returns (mixed_x, y_a, y_b, lam) — resnet50_test.py:355-376."""
+    k_lam, k_perm = jax.random.split(key)
+    lam = sample_lam(k_lam, alpha)
+    index = jax.random.permutation(k_perm, x.shape[0])
+    x_perm = x[index]
+    lam_b = lam.astype(x.dtype)
+    mixed = lam_b * x + (1.0 - lam_b) * x_perm
+    if intra_only:
+        # same-class pairs keep the original sample (the reference's Python
+        # loop at resnet50_test.py:365-373, vectorized)
+        same = (y == y[index]).reshape((-1,) + (1,) * (x.ndim - 1))
+        mixed = jnp.where(same, x, mixed)
+    return mixed, y, y[index], lam
+
+
+def init_meta_lambda(key: jax.Array, batch_size: int) -> jax.Array:
+    """Pre-sigmoid per-sample lambda parameter, U[0,1) init like the
+    reference (resnet50_test.py:390)."""
+    return jax.random.uniform(key, (batch_size, 1, 1, 1))
+
+
+def init_attn_lambda(key: jax.Array, batch_size: int, height: int, width: int,
+                     channels: int = 3) -> jax.Array:
+    """Per-pixel lambda map parameter (resnet50_test.py:410), NHWC."""
+    return jax.random.uniform(key, (batch_size, height, width, channels))
+
+
+def meta_mixup_apply(lam_param: jax.Array, key: jax.Array, x: jax.Array,
+                     y: jax.Array
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Learnable mixup: lam = sigmoid(lam_param) per sample
+    (resnet50_test.py:396-401).  `lam_param` is a trainable leaf —
+    gradients flow through the mixed input into it."""
+    index = jax.random.permutation(key, x.shape[0])
+    lam = jax.nn.sigmoid(lam_param).astype(x.dtype)
+    mixed = lam * x + (1.0 - lam) * x[index]
+    return mixed, y, y[index], lam.reshape(x.shape[0])
+
+
+def attn_mixup_apply(lam_param: jax.Array, key: jax.Array, x: jax.Array,
+                     y: jax.Array
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Attention-map mixup (resnet50_test.py:417-424): per-pixel sigmoid
+    map mixes the images; the per-sample loss weight is the map's squared
+    norm (the reference's ``lam_scale``)."""
+    index = jax.random.permutation(key, x.shape[0])
+    lam_map = jax.nn.sigmoid(lam_param).astype(x.dtype)
+    mixed = lam_map * x + (1.0 - lam_map) * x[index]
+    lam_scale = jnp.sum(lam_map.reshape(x.shape[0], -1) ** 2, axis=1)
+    return mixed, y, y[index], lam_scale
+
+
+def mixup_criterion(criterion: Callable, pred: jax.Array, y_a: jax.Array,
+                    y_b: jax.Array, lam: jax.Array) -> jax.Array:
+    """lam * CE(pred, y_a) + (1-lam) * CE(pred, y_b) — resnet50_test.py:451."""
+    return lam * criterion(pred, y_a) + (1.0 - lam) * criterion(pred, y_b)
+
+
+def mixup_criterion_meta(per_sample_criterion: Callable, pred: jax.Array,
+                         y_a: jax.Array, y_b: jax.Array,
+                         lam: jax.Array) -> jax.Array:
+    """Per-sample-lambda criterion (resnet50_test.py:455-457): reduction
+    'none' then mean, with lam shaped (batch,)."""
+    losses = (lam * per_sample_criterion(pred, y_a)
+              + (1.0 - lam) * per_sample_criterion(pred, y_b))
+    return jnp.mean(losses)

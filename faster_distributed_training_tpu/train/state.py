@@ -1,0 +1,68 @@
+"""TrainState: the full training pytree — params, BN statistics, optimizer
+state (including NGD Fisher factors), loss scale, step, RNG root.
+
+Unlike the reference's checkpoint (net/acc/epoch only,
+resnet50_test.py:663-675 — optimizer, scheduler, scaler and Fisher state
+are all lost on resume, SURVEY.md §5), everything needed to continue a
+run bit-exactly lives in this one structure and is what
+train/checkpoint.py serializes."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from faster_distributed_training_tpu.train.amp import (LossScaleState,
+                                                       fresh_loss_scale)
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    loss_scale: LossScaleState
+    rng: jax.Array
+    # static (not traced / not checkpointed):
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads, extra_params=None):
+        updates, new_opt_state = self.tx.update(grads, self.opt_state,
+                                                self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(step=self.step + 1, params=new_params,
+                            opt_state=new_opt_state)
+
+
+def create_train_state(model, tx: optax.GradientTransformation,
+                       sample_input, rng: jax.Array,
+                       init_kwargs: Optional[dict] = None,
+                       extra_params: Optional[dict] = None) -> TrainState:
+    """Initialize model variables + optimizer state.
+
+    `extra_params` lets callers add trainable leaves outside the model —
+    the meta-mixup lambda lives at params['mixup'] so it is genuinely
+    optimized (fixing resnet50_test.py:525's never-trained lambda)."""
+    init_kwargs = dict(init_kwargs or {})
+    rngs = {"params": rng, "dropout": jax.random.fold_in(rng, 1),
+            "mixup": jax.random.fold_in(rng, 2)}
+    variables = model.init(rngs, sample_input, **init_kwargs)
+    # model params live under "model"; extra trainable leaves (e.g. the
+    # meta-mixup lambda as params["mixup_lambda"]) sit beside it.
+    params = {"model": variables["params"], **(extra_params or {})}
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.asarray(0, jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        loss_scale=fresh_loss_scale(),
+        rng=rng,
+        apply_fn=model.apply,
+        tx=tx,
+    )

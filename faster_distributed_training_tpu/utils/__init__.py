@@ -1,0 +1,5 @@
+"""Observability: plotting, profiling, device memory, logging."""
+
+from faster_distributed_training_tpu.utils.plotting import draw_graph  # noqa: F401
+from faster_distributed_training_tpu.utils.profiling import (  # noqa: F401
+    peak_memory_bytes, trace_profile)

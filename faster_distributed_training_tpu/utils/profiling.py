@@ -1,0 +1,32 @@
+"""Profiling hooks: jax.profiler traces + device memory, replacing the
+reference's cuda.max_memory_allocated prints (resnet50_test.py:623-625)."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace_profile(log_dir: Optional[str]) -> Iterator[None]:
+    """`with trace_profile('/tmp/trace'):` captures a TensorBoard-viewable
+    profiler trace when log_dir is set; no-op otherwise."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def peak_memory_bytes(device: Optional[jax.Device] = None) -> Optional[int]:
+    """Peak device memory if the backend exposes it (TPU does)."""
+    device = device or jax.local_devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if not stats:
+        return None
+    return stats.get("peak_bytes_in_use")
