@@ -1,0 +1,16 @@
+"""Input pipelines: CIFAR-10, AG News, synthetic fallbacks, prefetching
+loaders, device-side augmentation — the reference's L1 layer
+(torchvision_utils.py, dataset classes in resnet50_test.py:87-292 and
+transformer_test.py:82-138, DataLoaderX) rebuilt for TPU: static shapes,
+host->device double buffering, per-host sharding."""
+
+from faster_distributed_training_tpu.data.cifar10 import (  # noqa: F401
+    CIFAR10_MEAN, CIFAR10_STD, load_cifar10)
+from faster_distributed_training_tpu.data.synthetic import (  # noqa: F401
+    synthetic_cifar, synthetic_agnews)
+from faster_distributed_training_tpu.data.loader import (  # noqa: F401
+    BatchLoader, PrefetchIterator, shard_for_host)
+from faster_distributed_training_tpu.data.augment import (  # noqa: F401
+    augment_batch, normalize)
+from faster_distributed_training_tpu.data.agnews import (  # noqa: F401
+    AGNewsDataset, clean_text)

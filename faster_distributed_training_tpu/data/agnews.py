@@ -1,0 +1,153 @@
+"""AG News text-classification pipeline.
+
+Re-expression of the reference's AG_NEWS_DATASET + collate
+(transformer_test.py:82-138): CSV loading, HTML tag stripping
+(:73-75), URL stripping (:78-79), stopword removal (gensim's list in the
+reference; a built-in English list here — gensim is not a dependency),
+then tokenization.
+
+Tokenizer: HuggingFace ``bert-base-uncased`` when available locally
+(the reference downloads it, transformer_test.py:96); otherwise a
+deterministic hash-bucket word tokenizer so the pipeline works in
+zero-egress environments.  Labels arrive 1-indexed in the CSV and are
+shifted to 0-based (transformer_test.py:242).
+
+TPU-critical change: the reference pads each batch to its longest
+sequence (``padding='longest'``, transformer_test.py:97) — dynamic
+shapes that would retrigger XLA compilation every step.  Here sequences
+are padded into a fixed set of bucket lengths (cfg.seq_buckets), one
+compiled program per bucket (SURVEY.md §7 hard part 3)."""
+
+from __future__ import annotations
+
+import csv
+import html
+import os
+import re
+import zlib
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_TAG_RE = re.compile(r"<[^>]+>")
+_URL_RE = re.compile(r"https?://\S+|www\.\S+")
+_TOKEN_RE = re.compile(r"[a-z0-9']+")
+
+# compact English stopword list (gensim-equivalent role,
+# transformer_test.py:95)
+STOPWORDS = frozenset("""
+a about above after again against all am an and any are as at be because
+been before being below between both but by can did do does doing down
+during each few for from further had has have having he her here hers
+him his how i if in into is it its just me more most my no nor not now
+of off on once only or other our out over own s same she should so some
+such t than that the their them then there these they this those through
+to too under until up very was we were what when where which while who
+whom why will with you your
+""".split())
+
+
+def clean_text(text: str) -> str:
+    """strip HTML + URLs + stopwords (transformer_test.py:73-79,95)."""
+    text = html.unescape(text)
+    text = _TAG_RE.sub(" ", text)
+    text = _URL_RE.sub(" ", text)
+    words = _TOKEN_RE.findall(text.lower())
+    return " ".join(w for w in words if w not in STOPWORDS)
+
+
+class HashTokenizer:
+    """Deterministic fallback tokenizer: crc32 hash buckets + specials.
+    Same interface subset as the HF tokenizer the pipeline needs."""
+
+    def __init__(self, vocab_size: int = 30522):
+        self.vocab_size = vocab_size
+        self.pad_id, self.cls_id, self.sep_id, self.unk_id = 0, 101, 102, 100
+        self._reserved = 999  # ids below this are never produced by hashing
+
+    def encode(self, text: str, max_len: int) -> List[int]:
+        ids = [self.cls_id]
+        for w in text.split()[:max_len - 2]:
+            h = zlib.crc32(w.encode()) % (self.vocab_size - self._reserved)
+            ids.append(h + self._reserved)
+        ids.append(self.sep_id)
+        return ids
+
+
+def _load_hf_tokenizer():
+    try:
+        from transformers import AutoTokenizer
+        return AutoTokenizer.from_pretrained("bert-base-uncased",
+                                             local_files_only=True)
+    except Exception:
+        return None
+
+
+def bucket_length(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (last bucket truncates)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class AGNewsDataset:
+    """Map-style dataset over AG News CSV (class,title,description rows)."""
+
+    def __init__(self, data_dir: str, train: bool = True,
+                 buckets: Sequence[int] = (64, 128, 256, 512),
+                 tokenizer=None, subset_stride: int = 1):
+        path = os.path.join(data_dir, "ag_news",
+                            "train.csv" if train else "test.csv")
+        self.buckets = tuple(buckets)
+        self.tokenizer = tokenizer
+        if self.tokenizer is None:
+            self.tokenizer = _load_hf_tokenizer() or HashTokenizer()
+        self.samples: List[Tuple[str, int]] = []
+        if os.path.exists(path):
+            with open(path, newline="", encoding="utf-8") as f:
+                for i, row in enumerate(csv.reader(f)):
+                    if subset_stride > 1 and i % subset_stride:
+                        continue
+                    label = int(row[0]) - 1          # 1-indexed -> 0-based
+                    text = " ".join(row[1:])
+                    self.samples.append((clean_text(text), label))
+        else:
+            raise FileNotFoundError(
+                f"AG News CSV not found at {path}; use data.synthetic."
+                f"synthetic_agnews for offline runs")
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def num_classes(self) -> int:
+        return 4
+
+    def vocab_size(self) -> int:
+        tk = self.tokenizer
+        return getattr(tk, "vocab_size", 30522)
+
+    def encode_batch(self, indices: Sequence[int], max_len: int = 512
+                     ) -> Dict[str, np.ndarray]:
+        """Tokenize + pad to the bucketed length (static shapes)."""
+        texts = [self.samples[i][0] for i in indices]
+        labels = np.asarray([self.samples[i][1] for i in indices], np.int32)
+        if isinstance(self.tokenizer, HashTokenizer):
+            encoded = [self.tokenizer.encode(t, max_len) for t in texts]
+            pad_id = self.tokenizer.pad_id
+        else:
+            encoded = [self.tokenizer.encode(t, truncation=True,
+                                             max_length=max_len)
+                       for t in texts]
+            pad_id = self.tokenizer.pad_token_id
+        longest = max(len(e) for e in encoded)
+        L = bucket_length(longest, [b for b in self.buckets if b <= max_len]
+                          or [max_len])
+        tokens = np.full((len(encoded), L), pad_id, np.int32)
+        mask = np.zeros((len(encoded), L), np.int32)
+        for i, e in enumerate(encoded):
+            e = e[:L]
+            tokens[i, :len(e)] = e
+            mask[i, :len(e)] = 1
+        return {"tokens": tokens, "token_types": np.zeros_like(tokens),
+                "mask": mask, "label": labels}

@@ -1,0 +1,67 @@
+"""CIFAR-10: pickled-batch loading with MD5 verification, NHWC numpy.
+
+Re-expression of the reference's vendored CIFAR10 dataset
+(resnet50_test.py:161-292): same download URL and per-file MD5 table
+semantics, but decoded once into contiguous NHWC uint8 arrays instead of
+per-sample __getitem__ (TPU pipelines want whole-epoch tensors the
+augmentation can vmap over).  The reference's one behavioral change over
+torchvision — returning normalized float tensors instead of PIL
+(resnet50_test.py:264) — is inherited: `load_cifar10(normalize=True)`
+hands back float32 arrays already normalized."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, Tuple
+
+import numpy as np
+
+from faster_distributed_training_tpu.data import download as dl
+
+URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+TGZ_MD5 = "c58f30108f718f92721af3b95e74349a"
+BASE = "cifar-10-batches-py"
+TRAIN_FILES = {
+    "data_batch_1": "c99cafc152244af753f735de768cd75f",
+    "data_batch_2": "d4bba439e000b95fd0a9bffe97cbabec",
+    "data_batch_3": "54ebc095f3ab1f0389bbae665268c751",
+    "data_batch_4": "634d18415352ddfa80567beed471001a",
+    "data_batch_5": "482c414d41f54cd18b22e5b47cb7c3cb",
+}
+TEST_FILES = {"test_batch": "40351d587109b95175f43aff81a1287e"}
+
+# the reference's normalize constants (resnet50_test.py:306,315)
+CIFAR10_MEAN = np.asarray([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.asarray([0.2023, 0.1994, 0.2010], np.float32)
+
+
+def _load_batches(root: str, files: Dict[str, str], verify: bool
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    images, labels = [], []
+    for name, md5 in files.items():
+        path = os.path.join(root, BASE, name)
+        if verify and not dl.check_integrity(path, md5):
+            raise RuntimeError(f"corrupt or missing CIFAR batch: {path}")
+        with open(path, "rb") as f:
+            entry = pickle.load(f, encoding="latin1")
+        images.append(entry["data"])
+        labels.extend(entry.get("labels", entry.get("fine_labels")))
+    # (N, 3072) row-major CHW -> NHWC uint8
+    x = np.vstack(images).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return np.ascontiguousarray(x), np.asarray(labels, np.int32)
+
+
+def load_cifar10(data_dir: str, train: bool = True, download: bool = True,
+                 verify: bool = True, normalize: bool = False
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (images NHWC uint8 [or normalized float32], labels int32)."""
+    files = TRAIN_FILES if train else TEST_FILES
+    present = all(os.path.isfile(os.path.join(data_dir, BASE, n))
+                  for n in files)
+    if not present and download:
+        dl.download_and_extract_archive(URL, data_dir, md5=TGZ_MD5)
+    x, y = _load_batches(data_dir, files, verify)
+    if normalize:
+        x = (x.astype(np.float32) / 255.0 - CIFAR10_MEAN) / CIFAR10_STD
+    return x, y

@@ -1,0 +1,89 @@
+"""Dataset download / integrity / extraction infrastructure.
+
+Re-expression of the *capabilities* of the vendored torchvision utils
+(torchvision_utils.py:82-91 MD5 verify, :123-171 download with redirect
+handling, :391-442 archive extraction) in ~1/5 the code: stdlib only,
+no Google-Drive special cases (CIFAR/AG News don't need them).
+
+In zero-egress environments download attempts fail fast with a clear
+message pointing at the synthetic fallback."""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import os
+import tarfile
+import urllib.error
+import urllib.request
+import zipfile
+from typing import Optional
+
+
+def check_md5(path: str, md5: str, chunk: int = 1 << 20) -> bool:
+    """torchvision_utils.py:82-91 equivalent."""
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest() == md5
+
+
+def check_integrity(path: str, md5: Optional[str] = None) -> bool:
+    if not os.path.isfile(path):
+        return False
+    return True if md5 is None else check_md5(path, md5)
+
+
+def download_url(url: str, root: str, filename: Optional[str] = None,
+                 md5: Optional[str] = None) -> str:
+    os.makedirs(root, exist_ok=True)
+    filename = filename or os.path.basename(url)
+    path = os.path.join(root, filename)
+    if check_integrity(path, md5):
+        return path
+    try:
+        req = urllib.request.Request(url, headers={"User-Agent": "fdt-tpu"})
+        with urllib.request.urlopen(req, timeout=30) as r, \
+                open(path, "wb") as f:
+            while True:
+                block = r.read(1 << 20)
+                if not block:
+                    break
+                f.write(block)
+    except (urllib.error.URLError, OSError) as e:
+        raise RuntimeError(
+            f"could not download {url} ({e}); in offline environments "
+            f"place the file at {path} manually or use the synthetic "
+            f"dataset (data.synthetic)") from e
+    if md5 and not check_md5(path, md5):
+        raise RuntimeError(f"MD5 mismatch for {path}")
+    return path
+
+
+def extract_archive(path: str, dest: Optional[str] = None) -> str:
+    """tar(.gz/.bz2/.xz) / zip / lone .gz — torchvision_utils.py:391-421."""
+    dest = dest or os.path.dirname(path)
+    if tarfile.is_tarfile(path):
+        with tarfile.open(path) as t:
+            t.extractall(dest, filter="data")
+    elif zipfile.is_zipfile(path):
+        with zipfile.ZipFile(path) as z:
+            z.extractall(dest)
+    elif path.endswith(".gz"):
+        out = os.path.join(dest, os.path.basename(path)[:-3])
+        with gzip.open(path, "rb") as f, open(out, "wb") as o:
+            o.write(f.read())
+    else:
+        raise ValueError(f"unknown archive type: {path}")
+    return dest
+
+
+def download_and_extract_archive(url: str, root: str,
+                                 md5: Optional[str] = None) -> str:
+    """torchvision_utils.py:424-442 equivalent."""
+    path = download_url(url, root, md5=md5)
+    return extract_archive(path, root)

@@ -1,0 +1,132 @@
+"""Batch loading: per-host sharding, background prefetch, device staging.
+
+Replaces the reference's DataLoaderX/BackgroundGenerator + pin_memory +
+non_blocking H2D stack (resnet50_test.py:41-43,321-352) and
+DistributedSampler (:331):
+
+  * ``shard_for_host`` — every process loads only its slice of the
+    global batch, reshuffled per epoch (the reference's ResNet loop
+    forgets ``set_epoch``, SURVEY.md §5 — fixed here);
+  * ``PrefetchIterator`` — a daemon thread keeps a bounded queue of
+    ready batches (BackgroundGenerator equivalent);
+  * ``device_prefetch`` — stages the next batch onto device while the
+    current one computes (the pin_memory+non_blocking double-buffer,
+    TPU style);
+  * ``drop_last`` is always on for static shapes (resnet50_test.py:330).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def shard_for_host(n: int, epoch: int, seed: int = 0, shuffle: bool = True,
+                   process_index: Optional[int] = None,
+                   process_count: Optional[int] = None) -> np.ndarray:
+    """Global permutation (identical on every host — seeded by (seed, epoch))
+    sliced to this host's contiguous shard."""
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    if shuffle:
+        order = np.random.default_rng((seed, epoch)).permutation(n)
+    else:
+        order = np.arange(n)
+    per = n // pc
+    return order[pi * per:(pi + 1) * per]
+
+
+class BatchLoader:
+    """Iterates dict batches from an array dataset (images) or an
+    ``encode_batch``-style text dataset, host-sharded, drop_last."""
+
+    def __init__(self, data, batch_size: int, epoch: int = 0, seed: int = 0,
+                 shuffle: bool = True, max_len: int = 512,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        self.data = data
+        self.batch_size = batch_size
+        self.epoch = epoch
+        self.seed = seed
+        self.shuffle = shuffle
+        self.max_len = max_len
+        self._pi, self._pc = process_index, process_count
+        self.is_text = hasattr(data, "encode_batch")
+        self._n = len(data) if self.is_text else len(data[0])
+
+    def __len__(self) -> int:
+        pc = self._pc if self._pc is not None else jax.process_count()
+        return (self._n // pc) // self.batch_size
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        idx = shard_for_host(self._n, self.epoch, self.seed, self.shuffle,
+                             self._pi, self._pc)
+        bs = self.batch_size
+        for start in range(0, (len(idx) // bs) * bs, bs):
+            batch_idx = idx[start:start + bs]
+            if self.is_text:
+                yield self.data.encode_batch(batch_idx, self.max_len)
+            else:
+                x, y = self.data
+                yield {"image": x[batch_idx], "label": y[batch_idx]}
+
+
+class PrefetchIterator:
+    """Background-thread prefetch with a bounded queue — the
+    BackgroundGenerator role (resnet50_test.py:41-43)."""
+
+    _DONE = object()
+
+    def __init__(self, iterable: Iterable, depth: int = 2):
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._err: Optional[BaseException] = None
+
+        def worker():
+            try:
+                for item in iterable:
+                    self._q.put(item)
+            except BaseException as e:  # propagate into the consumer
+                self._err = e
+            finally:
+                self._q.put(self._DONE)
+
+        self._t = threading.Thread(target=worker, daemon=True)
+        self._t.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._DONE:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+
+def device_prefetch(iterator: Iterable, put_fn: Callable[[Any], Any],
+                    depth: int = 2) -> Iterator:
+    """Keep `depth` batches already transferred to device ahead of the
+    consumer — overlaps H2D with compute like pin_memory+non_blocking
+    (resnet50_test.py:522)."""
+    staged = []
+    it = iter(iterator)
+    try:
+        for _ in range(depth):
+            staged.append(put_fn(next(it)))
+    except StopIteration:
+        pass
+    while staged:
+        nxt = None
+        try:
+            nxt = put_fn(next(it))
+        except StopIteration:
+            pass
+        yield staged.pop(0)
+        if nxt is not None:
+            staged.append(nxt)

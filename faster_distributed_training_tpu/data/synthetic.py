@@ -1,0 +1,73 @@
+"""Synthetic datasets, shape- and dtype-compatible with the real ones.
+
+Used by tests and benchmarks in zero-egress environments (no CIFAR/AG
+News download possible) — the data *pipeline* code paths (sharding,
+prefetch, augmentation, bucketing) are identical; only the bytes are
+random.  Labels are derived from the data so models can overfit them
+(useful for convergence smoke tests)."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import numpy as np
+
+
+def synthetic_cifar(n: int = 1024, seed: int = 0, num_classes: int = 10
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """(NHWC uint8 images, int32 labels) with learnable class structure:
+    class k images are noise biased by a per-class mean pattern."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    prototypes = rng.integers(0, 256, size=(num_classes, 32, 32, 3))
+    noise = rng.normal(0, 40, size=(n, 32, 32, 3))
+    x = np.clip(prototypes[labels] * 0.6 + noise + 50, 0, 255).astype(np.uint8)
+    return x, labels
+
+
+def synthetic_agnews(n: int = 512, seed: int = 0, vocab: int = 30522,
+                     num_classes: int = 4, max_len: int = 128):
+    """An AGNewsDataset-compatible object with random token sequences."""
+    rng = np.random.default_rng(seed)
+
+    class _Synthetic:
+        buckets = (64, 128, 256, 512)
+
+        def __init__(self):
+            self._labels = rng.integers(0, num_classes, n).astype(np.int32)
+            self._lens = rng.integers(8, max_len, n)
+            # class-dependent token distribution so it is learnable
+            self._tokens = [
+                (rng.integers(1000, vocab, size=ln)
+                 + self._labels[i]) % vocab for i, ln in enumerate(self._lens)]
+
+        def __len__(self):
+            return n
+
+        def num_classes(self):
+            return num_classes
+
+        def vocab_size(self):
+            return vocab
+
+        def encode_batch(self, indices: Sequence[int], max_len: int = 512
+                         ) -> Dict[str, np.ndarray]:
+            from faster_distributed_training_tpu.data.agnews import (
+                bucket_length)
+            seqs = [self._tokens[i][:max_len - 2] for i in indices]
+            longest = max(len(s) + 2 for s in seqs)
+            L = bucket_length(longest,
+                              [b for b in self.buckets if b <= max_len]
+                              or [max_len])
+            tokens = np.zeros((len(seqs), L), np.int32)
+            mask = np.zeros((len(seqs), L), np.int32)
+            for i, s in enumerate(seqs):
+                row = [101] + list(s) + [102]
+                tokens[i, :len(row)] = row
+                mask[i, :len(row)] = 1
+            return {"tokens": tokens,
+                    "token_types": np.zeros_like(tokens),
+                    "mask": mask,
+                    "label": self._labels[np.asarray(indices)]}
+
+    return _Synthetic()

@@ -1,0 +1,156 @@
+"""Data-layer tests: sharding/reshuffle, prefetch, augmentation shapes,
+text cleaning + bucketing, synthetic datasets, MD5 infra."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from faster_distributed_training_tpu.data import (
+    BatchLoader, PrefetchIterator, augment_batch, clean_text, normalize,
+    synthetic_agnews, synthetic_cifar)
+from faster_distributed_training_tpu.data.agnews import (HashTokenizer,
+                                                         bucket_length)
+from faster_distributed_training_tpu.data.loader import (device_prefetch,
+                                                         shard_for_host)
+from faster_distributed_training_tpu.data import download as dl
+
+
+class TestSharding:
+    def test_hosts_partition_disjointly(self):
+        shards = [shard_for_host(100, epoch=0, process_index=i,
+                                 process_count=4) for i in range(4)]
+        all_idx = np.concatenate(shards)
+        assert len(all_idx) == 100 and len(set(all_idx.tolist())) == 100
+
+    def test_epoch_reshuffles(self):
+        # the set_epoch fix: different epoch -> different order
+        a = shard_for_host(64, epoch=0, process_index=0, process_count=1)
+        b = shard_for_host(64, epoch=1, process_index=0, process_count=1)
+        assert not np.array_equal(a, b)
+        # but deterministic per (seed, epoch)
+        a2 = shard_for_host(64, epoch=0, process_index=0, process_count=1)
+        np.testing.assert_array_equal(a, a2)
+
+
+class TestLoaders:
+    def test_image_loader_shapes_and_drop_last(self):
+        x, y = synthetic_cifar(70)
+        loader = BatchLoader((x, y), batch_size=16, process_index=0,
+                             process_count=1)
+        batches = list(loader)
+        assert len(batches) == 4  # 70//16, last partial dropped
+        assert batches[0]["image"].shape == (16, 32, 32, 3)
+        assert batches[0]["label"].shape == (16,)
+
+    def test_text_loader_buckets(self):
+        ds = synthetic_agnews(64, max_len=100)
+        loader = BatchLoader(ds, batch_size=8, process_index=0,
+                             process_count=1)
+        for batch in loader:
+            L = batch["tokens"].shape[1]
+            assert L in (64, 128), f"unbucketed length {L}"
+            assert batch["mask"].shape == batch["tokens"].shape
+
+    def test_prefetch_iterator_order_and_error(self):
+        assert list(PrefetchIterator(range(10))) == list(range(10))
+
+        def boom():
+            yield 1
+            raise RuntimeError("worker died")
+
+        it = PrefetchIterator(boom())
+        assert next(it) == 1
+        with pytest.raises(RuntimeError):
+            list(it)
+
+    def test_device_prefetch(self):
+        seen = list(device_prefetch(iter(range(7)), lambda x: x * 2, depth=2))
+        assert seen == [0, 2, 4, 6, 8, 10, 12]
+
+
+class TestAugment:
+    def test_shapes_and_determinism(self):
+        x = jnp.asarray(synthetic_cifar(8)[0])
+        key = jax.random.PRNGKey(0)
+        out = jax.jit(lambda k, v: augment_batch(k, v, True))(key, x)
+        assert out.shape == (8, 32, 32, 3) and out.dtype == jnp.float32
+        out2 = augment_batch(key, x, True)
+        # jit fuses the normalize arithmetic differently — bitwise equality
+        # is not expected, 1e-5 absolute is.
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                                   atol=1e-5)
+
+    def test_eval_is_normalize_only(self):
+        x = jnp.asarray(synthetic_cifar(4)[0])
+        out = augment_batch(jax.random.PRNGKey(0), x, train=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(normalize(x)),
+                                   rtol=1e-6)
+
+    def test_normalize_range(self):
+        x = jnp.full((2, 32, 32, 3), 255, jnp.uint8)
+        out = normalize(x)
+        assert float(out.max()) < 4.0  # (1-0.44)/0.2 ~ 2.7
+
+
+class TestText:
+    def test_clean_text(self):
+        s = clean_text("<b>Wall St.</b> see http://x.co/y falls THE again")
+        assert "<b>" not in s and "http" not in s
+        assert "the" not in s.split()       # stopword removed
+        assert "falls" in s
+
+    def test_hash_tokenizer_deterministic(self):
+        tk = HashTokenizer()
+        a = tk.encode("hello world", 16)
+        b = tk.encode("hello world", 16)
+        assert a == b
+        assert a[0] == tk.cls_id and a[-1] == tk.sep_id
+        assert all(0 <= t < tk.vocab_size for t in a)
+
+    def test_bucket_length(self):
+        assert bucket_length(10, (64, 128)) == 64
+        assert bucket_length(65, (64, 128)) == 128
+        assert bucket_length(500, (64, 128)) == 128  # truncation bucket
+
+
+class TestDownloadInfra:
+    def test_md5(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"hello")
+        import hashlib
+        md5 = hashlib.md5(b"hello").hexdigest()
+        assert dl.check_md5(str(p), md5)
+        assert not dl.check_md5(str(p), "0" * 32)
+        assert dl.check_integrity(str(p), md5)
+        assert not dl.check_integrity(str(tmp_path / "missing"), md5)
+
+    def test_extract_tar(self, tmp_path):
+        import tarfile
+        src = tmp_path / "inner.txt"
+        src.write_text("data")
+        tar = tmp_path / "a.tar.gz"
+        with tarfile.open(tar, "w:gz") as t:
+            t.add(src, arcname="inner.txt")
+        dest = tmp_path / "out"
+        dest.mkdir()
+        dl.extract_archive(str(tar), str(dest))
+        assert (dest / "inner.txt").read_text() == "data"
+
+    def test_offline_download_fails_clearly(self, tmp_path):
+        with pytest.raises(RuntimeError, match="synthetic"):
+            dl.download_url("http://127.0.0.1:9/none.bin", str(tmp_path))
+
+
+class TestSynthetic:
+    def test_cifar_learnable_structure(self):
+        x, y = synthetic_cifar(256, seed=1)
+        assert x.dtype == np.uint8 and y.dtype == np.int32
+        # same-class images are more similar than cross-class on average
+        x_f = x.astype(np.float32).reshape(256, -1)
+        same = cross = 0.0
+        c0 = x_f[y == y[0]]
+        c1 = x_f[y != y[0]]
+        same = np.linalg.norm(c0[0] - c0[1])
+        cross = np.linalg.norm(c0[0] - c1[0])
+        assert same < cross
