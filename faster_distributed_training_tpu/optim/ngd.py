@@ -185,47 +185,84 @@ def _core_step(w, d, rho, x, tr_xxt, updating, hp: NGDHyperParams):
     return (w1, d1, rho1), x_hat
 
 
+def _member_init(x, tr_xxt, rank: int, hp: NGDHyperParams):
+    """Lazy init (ngd_optimizer.py:356-376): reset to the default factors
+    then run 3 discarded updates on this same minibatch — a cheap
+    power-iteration approximation of an SVD init."""
+    dim = x.shape[1]
+    fresh = init_ng_state(dim, dataclasses.replace(hp, rank=rank), x.dtype)
+
+    def body(_, wdr):
+        (w, d, rho), _x = _core_step(*wdr, x, tr_xxt, True, hp)
+        return (w, d, rho)
+
+    return lax.fori_loop(0, 3, body, (fresh.w, fresh.d, fresh.rho))
+
+
+def _member_finalize(w, d, rho, w1, d1, rho1, x, x_hat, tr_xxt):
+    """Norm-preserving rescale (ngd:168); on NaN return raw grads AND roll
+    back the factors (improvement over ngd:158-165 which keeps them)."""
+    final = jnp.sum(x_hat * x_hat)
+    good = jnp.isfinite(final)
+    out = jnp.where(good, x_hat * jnp.sqrt(tr_xxt / (final + 1.0e-30)), x)
+    w1 = jnp.where(good, w1, w)
+    d1 = jnp.where(good, d1, d)
+    rho1 = jnp.where(good, rho1, rho)
+    return w1, d1, rho1, out
+
+
 def _precondition_2d(state: OnlineNaturalGradientState, x: jax.Array,
                      hp: NGDHyperParams
                      ) -> Tuple[OnlineNaturalGradientState, jax.Array]:
     """Precondition a (N, dim) matrix; full semantics of
     _precondition_directions2 (ngd_optimizer.py:138-168) including lazy
     power-iteration init, norm-preserving rescale and NaN fallback."""
-    dim = x.shape[1]
     rank = state.w.shape[0]
-
-    # Lazy init (ngd_optimizer.py:356-376): at t==0, reset to the default
-    # factors then run 3 discarded updates on this same minibatch — a cheap
-    # power-iteration approximation of an SVD init.
-    def init_branch(carry):
-        del carry
-        fresh = init_ng_state(dim, dataclasses.replace(hp, rank=rank),
-                              x.dtype)
-        def body(_, wdr):
-            (w, d, rho), _x = _core_step(*wdr, x, tr_xxt, True, hp)
-            return (w, d, rho)
-        return lax.fori_loop(0, 3, body, (fresh.w, fresh.d, fresh.rho))
-
-    def carry_branch(carry):
-        return carry
-
     tr_xxt = jnp.sum(x * x)
-    w, d, rho = lax.cond(state.t == 0, init_branch, carry_branch,
-                         (state.w, state.d, state.rho))
+    w, d, rho = lax.cond(
+        state.t == 0,
+        lambda carry: _member_init(x, tr_xxt, rank, hp),
+        lambda carry: carry,
+        (state.w, state.d, state.rho))
 
     updating = jnp.logical_or(state.t < NUM_INITIAL_ITERS,
                               state.t % hp.update_period == 0)
     (w1, d1, rho1), x_hat = _core_step(w, d, rho, x, tr_xxt, updating, hp)
-
-    final = jnp.sum(x_hat * x_hat)
-    good = jnp.isfinite(final)
-    # norm-preserving rescale (ngd:168); on NaN return raw grads AND roll
-    # back the factors (improvement over ngd:158-165 which keeps them).
-    out = jnp.where(good, x_hat * jnp.sqrt(tr_xxt / (final + 1.0e-30)), x)
-    w1 = jnp.where(good, w1, w)
-    d1 = jnp.where(good, d1, d)
-    rho1 = jnp.where(good, rho1, rho)
+    w1, d1, rho1, out = _member_finalize(w, d, rho, w1, d1, rho1, x, x_hat,
+                                         tr_xxt)
     return OnlineNaturalGradientState(w1, d1, rho1, state.t + 1), out
+
+
+def _group_precondition(gw, gd, grho, t, xs, hp: NGDHyperParams):
+    """Vmapped precondition for a GROUP of same-shaped axis-states.
+
+    gw: (G, rank, dim), gd: (G, rank), grho: (G,), xs: (G, N, dim); `t` is
+    the SHARED scalar step counter — every state in a training run is
+    preconditioned every step, so the counters are always in lockstep
+    (the reference keeps one `t` per OnlineNaturalGradient but they all
+    advance identically, ngd_optimizer.py:186).  Keeping `t` scalar keeps
+    the lax.cond predicates unbatched, so under vmap the update stays a
+    real branch (executed every update_period steps) instead of being
+    flattened into always-executed selects."""
+    rank = gw.shape[1]
+    trs = jnp.sum(xs * xs, axis=(1, 2))
+
+    init_all = jax.vmap(lambda x, tr: _member_init(x, tr, rank, hp))
+    gw, gd, grho = lax.cond(
+        t == 0,
+        lambda carry: init_all(xs, trs),
+        lambda carry: carry,
+        (gw, gd, grho))
+
+    updating = jnp.logical_or(t < NUM_INITIAL_ITERS,
+                              t % hp.update_period == 0)
+
+    def member(w, d, rho, x, tr):
+        (w1, d1, rho1), x_hat = _core_step(w, d, rho, x, tr, updating, hp)
+        return _member_finalize(w, d, rho, w1, d1, rho1, x, x_hat, tr)
+
+    gw1, gd1, grho1, outs = jax.vmap(member)(gw, gd, grho, xs, trs)
+    return gw1, gd1, grho1, outs
 
 
 def precondition(state: OnlineNaturalGradientState, grad: jax.Array,
@@ -247,11 +284,18 @@ def precondition(state: OnlineNaturalGradientState, grad: jax.Array,
 # ---------------------------------------------------------------------------
 
 
+class GroupState(NamedTuple):
+    """Stacked factors for a group of same-shaped axis-states."""
+    w: jax.Array     # (G, rank, dim)
+    d: jax.Array     # (G, rank)
+    rho: jax.Array   # (G,)
+
+
 class ScaleByNGDState(NamedTuple):
-    # pytree-of-pytrees: for each param leaf, a tuple with one
-    # OnlineNaturalGradientState per preconditioned axis (None markers are
-    # encoded as dim-1 no-op states to keep the tree static).
-    axes: Any
+    t: jax.Array                   # () int32 — shared step counter
+    axes: Any                      # ungrouped mode: per-leaf tuples of
+                                   # OnlineNaturalGradientState (or None)
+    groups: Any                    # grouped mode: {key: GroupState}
 
 
 def _param_axis_states(p: jax.Array, hp: NGDHyperParams, dtype
@@ -266,27 +310,94 @@ def _param_axis_states(p: jax.Array, hp: NGDHyperParams, dtype
     return tuple(states)
 
 
+def _group_key(r: int, n: int, dim: int, rank: int) -> str:
+    return f"r{r}:n{n}:d{dim}:k{rank}"
+
+
+def _build_plan(shapes, hp: NGDHyperParams):
+    """Static grouping plan: rounds[r] maps (n, dim, rank) -> leaf indices.
+    Round r preconditions axis r of every leaf with >r axes (sequential
+    dependency between rounds, parallel within — the reference's axis loop,
+    ngd_optimizer.py:489-491)."""
+    max_nd = max((len(s) for s in shapes), default=0)
+    rounds = []
+    for r in range(max_nd):
+        groups: Dict[Tuple[int, int, int], list] = {}
+        for i, shp in enumerate(shapes):
+            if len(shp) > r and shp[r] > 1:
+                dim = int(shp[r])
+                n = int(np.prod(shp)) // dim
+                rank_ = _default_rank(dim, hp.rank)
+                groups.setdefault((n, dim, rank_), []).append(i)
+        rounds.append(groups)
+    return rounds
+
+
 def scale_by_ngd(alpha: float = 4.0, rank: int = -1, update_period: int = 4,
-                 eta: float = 0.1, precond_dtype=jnp.float32
-                 ) -> optax.GradientTransformation:
+                 eta: float = 0.1, precond_dtype=jnp.float32,
+                 grouped: bool = True) -> optax.GradientTransformation:
     """The preconditioning stage of the reference's NGD.step
     (ngd_optimizer.py:481-491): per param, per axis with dim>1, apply the
-    online natural gradient sequentially (axis 0, then 1, ...)."""
+    online natural gradient sequentially (axis 0, then 1, ...).
+
+    grouped=True (default) batches all same-shaped axis-states per round
+    into stacked arrays and vmaps the core — turning ~600 tiny eigh/matmul
+    sites in a ResNet-50 graph into ~30 batched ones.  This is a pure
+    program-structure change: the math per state is identical (covered by
+    an equivalence test against the ungrouped path)."""
     hp = NGDHyperParams(alpha=alpha, rank=rank, update_period=update_period,
                         eta=eta)
 
-    def init_fn(params):
+    # -------------------- grouped (default) --------------------
+    def grouped_init(params):
+        shapes = [tuple(np.shape(p)) for p in jax.tree.leaves(params)]
+        plan = _build_plan(shapes, hp)
+        groups = {}
+        for r, round_groups in enumerate(plan):
+            for (n, dim, rank_), members in round_groups.items():
+                proto = init_ng_state(
+                    dim, dataclasses.replace(hp, rank=rank_), precond_dtype)
+                g = len(members)
+                groups[_group_key(r, n, dim, rank_)] = GroupState(
+                    w=jnp.broadcast_to(proto.w, (g,) + proto.w.shape),
+                    d=jnp.broadcast_to(proto.d, (g,) + proto.d.shape),
+                    rho=jnp.broadcast_to(proto.rho, (g,)),
+                )
+        return ScaleByNGDState(t=jnp.asarray(0, jnp.int32), axes=(),
+                               groups=groups)
+
+    def grouped_update(updates, state, params=None):
+        del params
+        flat, treedef = jax.tree.flatten(updates)
+        orig_dtypes = [g.dtype for g in flat]
+        work = [g.astype(precond_dtype) for g in flat]
+        shapes = [tuple(np.shape(g)) for g in flat]
+        plan = _build_plan(shapes, hp)
+        new_groups = dict(state.groups)
+        for r, round_groups in enumerate(plan):
+            for (n, dim, rank_), members in round_groups.items():
+                key = _group_key(r, n, dim, rank_)
+                moved = [jnp.moveaxis(work[i], r, -1) for i in members]
+                xs = jnp.stack([m.reshape(n, dim) for m in moved])
+                gs = new_groups[key]
+                gw, gd, grho, outs = _group_precondition(
+                    gs.w, gs.d, gs.rho, state.t, xs, hp)
+                new_groups[key] = GroupState(gw, gd, grho)
+                for slot, i in enumerate(members):
+                    out = outs[slot].reshape(moved[slot].shape)
+                    work[i] = jnp.moveaxis(out, -1, r)
+        out_flat = [g.astype(dt) for g, dt in zip(work, orig_dtypes)]
+        return (treedef.unflatten(out_flat),
+                ScaleByNGDState(t=state.t + 1, axes=(), groups=new_groups))
+
+    # -------------------- ungrouped (reference-shaped) --------------------
+    def ungrouped_init(params):
         axes = jax.tree.map(
-            lambda p: _param_axis_states(p, hp, precond_dtype), params,
-            is_leaf=lambda x: isinstance(x, jax.Array) or hasattr(x, "shape"))
-        return ScaleByNGDState(axes=axes)
+            lambda p: _param_axis_states(p, hp, precond_dtype), params)
+        return ScaleByNGDState(t=jnp.asarray(0, jnp.int32), axes=axes,
+                               groups={})
 
-    def _is_state_tuple(x):
-        return isinstance(x, tuple) and (
-            len(x) == 0 or x[0] is None
-            or isinstance(x[0], OnlineNaturalGradientState))
-
-    def update_fn(updates, state, params=None):
+    def ungrouped_update(updates, state, params=None):
         del params
 
         def per_leaf(g, ax_states):
@@ -306,16 +417,20 @@ def scale_by_ngd(alpha: float = 4.0, rank: int = -1, update_period: int = 4,
         out = [per_leaf(g, ax) for g, ax in zip(flat_updates, flat_axes)]
         new_updates = treedef.unflatten([o[0] for o in out])
         new_axes = treedef.unflatten([o[1] for o in out])
-        return new_updates, ScaleByNGDState(axes=new_axes)
+        return new_updates, ScaleByNGDState(t=state.t + 1, axes=new_axes,
+                                            groups={})
 
-    return optax.GradientTransformation(init_fn, update_fn)
+    if grouped:
+        return optax.GradientTransformation(grouped_init, grouped_update)
+    return optax.GradientTransformation(ungrouped_init, ungrouped_update)
 
 
 def ngd(learning_rate, momentum: float = 0.0, dampening: float = 0.0,
         weight_decay: float = 0.0, nesterov: bool = False,
         use_ngd: bool = True, alpha: float = 4.0, rank: int = -1,
         update_period: int = 4, eta: float = 0.1,
-        precond_dtype=jnp.float32) -> optax.GradientTransformation:
+        precond_dtype=jnp.float32,
+        grouped: bool = True) -> optax.GradientTransformation:
     """Full NGD optimizer, matching NGD.step order (ngd_optimizer.py:452-508):
     weight decay → per-axis preconditioning → momentum/nesterov → -lr."""
     if nesterov and (momentum <= 0 or dampening != 0):
@@ -326,7 +441,7 @@ def ngd(learning_rate, momentum: float = 0.0, dampening: float = 0.0,
         chain.append(optax.add_decayed_weights(weight_decay))
     if use_ngd:
         chain.append(scale_by_ngd(alpha, rank, update_period, eta,
-                                  precond_dtype))
+                                  precond_dtype, grouped=grouped))
     if momentum:
         # torch SGD momentum: buf = momentum*buf + (1-dampening)*g;
         # nesterov: d_p = g + momentum*buf — optax.trace matches.

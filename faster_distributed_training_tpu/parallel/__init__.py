@@ -20,3 +20,13 @@ from faster_distributed_training_tpu.parallel.sharding import (  # noqa: F401
     shard_pytree,
     tensor_parallel_rules,
 )
+from faster_distributed_training_tpu.parallel.placement import (  # noqa: F401
+    dp_size,
+    make_put_batch,
+    shard_train_state,
+    train_state_shardings,
+)
+from faster_distributed_training_tpu.parallel.collectives import (  # noqa: F401
+    all_reduce_metrics,
+    all_sum_across_processes,
+)

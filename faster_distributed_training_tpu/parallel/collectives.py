@@ -1,0 +1,33 @@
+"""Cross-host collectives for host-side values.
+
+Inside jit, collectives are implicit (shardings) or explicit
+(lax.psum/all_gather under shard_map — see ops/ring_attention.py).
+This module covers the remaining case: host-side Python values that
+must agree across processes — the reference's epoch-end
+``dist.all_reduce`` on loss/correct/total (resnet50_test.py:616-619,
+transformer_test.py:286-287)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def all_sum_across_processes(value) -> np.ndarray:
+    """SUM all-reduce of a host scalar/array across processes.  No-op for
+    single-process runs (the common single-controller TPU case)."""
+    if jax.process_count() == 1:
+        return np.asarray(value)
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(jnp.asarray(value))
+    return np.asarray(gathered).sum(axis=0)
+
+
+def all_reduce_metrics(metrics: Dict[str, float]) -> Dict[str, float]:
+    """resnet50_test.py:616-619 equivalent for a metrics dict."""
+    if jax.process_count() == 1:
+        return dict(metrics)
+    return {k: float(all_sum_across_processes(v)) for k, v in metrics.items()}

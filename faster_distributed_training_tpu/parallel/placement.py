@@ -1,0 +1,100 @@
+"""Placing the training state and batches onto a mesh.
+
+This is where the reference's three distribution strategies become
+sharding decisions (SURVEY.md §2 checklist):
+
+  * DP/DDP   — batch sharded over data axes, state replicated; XLA
+               compiles the gradient psum (DDP's bucketed all-reduce).
+  * FSDP     — additionally shard every large param/optimizer leaf over
+               the ``fsdp`` axis (ZeRO-3); XLA lowers the gradient psum
+               to reduce_scatter + all_gather exactly like FSDP's
+               C++ hooks (transformer_test.py:387-392).
+  * offload  — params/opt state pinned to host memory
+               (``memory_kind='pinned_host'``), the CPUOffload analog
+               (transformer_test.py:46-48).
+
+Batches are assembled from per-host shards with
+``jax.make_array_from_process_local_data`` — the DistributedSampler
+equivalent at the array level."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from faster_distributed_training_tpu.config import TrainConfig
+from faster_distributed_training_tpu.parallel.sharding import (
+    batch_spec, fsdp_partition_params)
+
+
+def data_axes(mesh: Mesh):
+    return tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+
+
+def dp_size(mesh: Mesh) -> int:
+    n = 1
+    for a in data_axes(mesh):
+        n *= mesh.shape[a]
+    return max(n, 1)
+
+
+def train_state_shardings(state, mesh: Mesh, cfg: TrainConfig):
+    """A TrainState-shaped pytree of NamedSharding."""
+    if cfg.fsdp and "fsdp" in mesh.axis_names:
+        specs = fsdp_partition_params(state, mesh, axis="fsdp")
+    else:
+        specs = jax.tree.map(lambda _: P(), state)
+    shardings = jax.tree.map(lambda spec: NamedSharding(mesh, spec), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    if cfg.host_offload and _supports_memory_kind(mesh):
+        # CPUOffload(offload_params=True) analog: only the big leaves —
+        # params and optimizer state — live in host memory.
+        pin = lambda s: NamedSharding(mesh, s.spec,            # noqa: E731
+                                      memory_kind="pinned_host")
+        shardings = shardings.replace(
+            params=jax.tree.map(pin, shardings.params),
+            opt_state=jax.tree.map(pin, shardings.opt_state))
+    return shardings
+
+
+def _supports_memory_kind(mesh: Mesh) -> bool:
+    try:
+        dev = np.ravel(mesh.devices)[0]
+        return "pinned_host" in {m.kind for m in dev.addressable_memories()}
+    except Exception:
+        return False
+
+
+def shard_train_state(state, mesh: Mesh, cfg: TrainConfig):
+    """device_put the full state per the DP/FSDP/offload policy.  Offload
+    applies only to params/opt_state (the big leaves)."""
+    shardings = train_state_shardings(state, mesh, cfg)
+    return jax.tree.map(jax.device_put, state, shardings)
+
+
+def make_put_batch(mesh: Optional[Mesh],
+                   augment_fn: Optional[Callable] = None
+                   ) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+    """Returns put_batch: host numpy dict -> global device arrays with the
+    batch dim sharded over the data axes.  Each process contributes its
+    local shard (multi-host DistributedSampler semantics)."""
+    if mesh is None:
+        if augment_fn is None:
+            return lambda b: b
+        return lambda b: augment_fn(b)
+
+    def put(batch: Dict[str, Any]) -> Dict[str, Any]:
+        out = {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            spec = batch_spec(mesh) if v.ndim >= 1 else P()
+            sharding = NamedSharding(mesh, spec)
+            out[k] = jax.make_array_from_process_local_data(sharding, v)
+        if augment_fn is not None:
+            out = augment_fn(out)
+        return out
+
+    return put

@@ -204,3 +204,34 @@ class TestBuilder:
         cfg = TrainConfig(use_ngd=True, lr=0.01)
         _, sched = build_optimizer(cfg, steps_per_epoch=1, lr_scale=4.0)
         assert np.isclose(float(sched(0)), 0.04)  # resnet50_test.py:482-483
+
+
+class TestGroupedNGD:
+    def test_grouped_matches_ungrouped(self):
+        params = {"conv": jnp.ones((3, 3, 4, 8)), "fc": jnp.ones((8, 10)),
+                  "fc2": jnp.ones((8, 10)), "bias": jnp.ones((8,))}
+        g_tx = scale_by_ngd(grouped=True, precond_dtype=jnp.float64)
+        u_tx = scale_by_ngd(grouped=False, precond_dtype=jnp.float64)
+        gs, us = g_tx.init(params), u_tx.init(params)
+        g_upd = jax.jit(g_tx.update)
+        u_upd = jax.jit(u_tx.update)
+        rng = np.random.default_rng(0)
+        for i in range(6):
+            grads = {k: jnp.asarray(rng.standard_normal(np.shape(v)))
+                     for k, v in params.items()}
+            go, gs = g_upd(grads, gs)
+            uo, us = u_upd(grads, us)
+            for k in params:
+                np.testing.assert_allclose(np.asarray(go[k]),
+                                           np.asarray(uo[k]),
+                                           rtol=1e-9, atol=1e-11,
+                                           err_msg=f"step {i} leaf {k}")
+
+    def test_grouped_state_shapes(self):
+        params = {"a": jnp.ones((4, 6)), "b": jnp.ones((4, 6))}
+        tx = scale_by_ngd(grouped=True)
+        st = tx.init(params)
+        # both leaves share one group per axis: (G=2, rank, dim)
+        keys = sorted(st.groups)
+        assert len(keys) == 2
+        assert st.groups[keys[0]].w.shape[0] == 2
