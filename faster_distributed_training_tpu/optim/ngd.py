@@ -43,7 +43,7 @@ delta=5e-4; preconditioning is a no-op for axes of dim 1
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import chex
 import jax

@@ -27,7 +27,15 @@ def all_sum_across_processes(value) -> np.ndarray:
 
 
 def all_reduce_metrics(metrics: Dict[str, float]) -> Dict[str, float]:
-    """resnet50_test.py:616-619 equivalent for a metrics dict."""
+    """SUM a dict of per-process-LOCAL counters across hosts.
+
+    The reference's epoch-end ``dist.all_reduce`` (resnet50_test.py:616-619)
+    sums per-rank local loss/correct/total.  In this framework the jitted
+    train/eval steps already produce GLOBAL metrics (the jit program spans
+    every process's devices and psums over the sharded batch) — do NOT feed
+    those here or multi-host runs inflate every metric by process_count.
+    Use only for values each process computes independently on host
+    (e.g. per-host input-pipeline counters, files read, bytes loaded)."""
     if jax.process_count() == 1:
         return dict(metrics)
     return {k: float(all_sum_across_processes(v)) for k, v in metrics.items()}
