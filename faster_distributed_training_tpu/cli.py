@@ -1,0 +1,271 @@
+"""CLI wiring shared by every entry point.
+
+The reference ships two monolithic scripts (resnet50_test.py,
+transformer_test.py) whose __main__ blocks duplicate device probing,
+data prep, model build, optimizer selection and the DDP/FSDP launch
+(resnet50_test.py:693-740, transformer_test.py:364-424).  Here all of
+that is ONE code path parameterized by TrainConfig; the root-level
+entry scripts are thin defaults-providers.
+
+Launch model: one process per host, all local chips visible
+(`--distributed` triggers jax.distributed.initialize) — replacing
+torchrun's process-per-GPU + NCCL rendezvous (run_distributed.sh:2-3).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from faster_distributed_training_tpu.config import (TrainConfig,
+                                                    build_parser,
+                                                    config_from_args)
+
+
+def setup_platform(cfg: TrainConfig) -> None:
+    """Select the JAX platform before first backend use.  `auto` keeps
+    whatever the environment provides (TPU when available)."""
+    import jax
+
+    if cfg.device != "auto":
+        want = "tpu" if cfg.device == "tpu" else "cpu"
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            os.environ["JAX_PLATFORMS"] = want
+
+
+def load_dataset(cfg: TrainConfig, train: bool):
+    """Returns a BatchLoader-compatible dataset for cfg.dataset.
+
+    CIFAR-10 falls back to synthetic data when the archive is absent and
+    cannot be downloaded (zero-egress environments) — the pipeline code
+    paths are identical (data/synthetic.py)."""
+    from faster_distributed_training_tpu.data import (load_cifar10,
+                                                      synthetic_agnews,
+                                                      synthetic_cifar)
+
+    if cfg.dataset == "cifar10":
+        try:
+            x, y = load_cifar10(cfg.data_dir, train=train)
+        except Exception as e:  # download impossible / corrupt archive
+            print(f"[data] CIFAR-10 unavailable ({e!r}); using synthetic")
+            x, y = synthetic_cifar(n=50000 if train else 10000,
+                                   seed=0 if train else 1)
+    elif cfg.dataset == "agnews":
+        from faster_distributed_training_tpu.data.agnews import AGNewsDataset
+        try:
+            return AGNewsDataset(cfg.data_dir, train=train,
+                                 buckets=cfg.seq_buckets)
+        except Exception as e:
+            print(f"[data] AG News unavailable ({e!r}); using synthetic")
+            return synthetic_agnews(n=12000 if train else 2000,
+                                    seed=0 if train else 1,
+                                    max_len=cfg.seq_len)
+    elif cfg.dataset == "synthetic":
+        if cfg.model == "transformer":
+            return synthetic_agnews(n=4096 if train else 1024,
+                                    seed=0 if train else 1,
+                                    max_len=cfg.seq_len)
+        x, y = synthetic_cifar(n=4096 if train else 1024,
+                               seed=0 if train else 1)
+    else:
+        raise ValueError(f"unknown dataset {cfg.dataset!r}")
+    if cfg.subset_stride > 1:   # tuning harness: 1/N stride subset
+        x, y = x[::cfg.subset_stride], y[::cfg.subset_stride]
+    return (x, y)
+
+
+def apply_subset(ds, stride: int):
+    """Stride-subset for text datasets (tuning/transformer_tuning.py:89-90)."""
+    if stride <= 1 or isinstance(ds, tuple):
+        return ds
+
+    class _Subset:
+        def __init__(self, base):
+            self._base = base
+            self._idx = np.arange(0, len(base), stride)
+
+        def __len__(self):
+            return len(self._idx)
+
+        def num_classes(self):
+            return self._base.num_classes()
+
+        def vocab_size(self):
+            return self._base.vocab_size()
+
+        def encode_batch(self, indices, max_len=512):
+            return self._base.encode_batch(self._idx[np.asarray(indices)],
+                                           max_len)
+
+    return _Subset(ds)
+
+
+def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None):
+    import jax.numpy as jnp
+
+    from faster_distributed_training_tpu.models import get_model
+
+    dtype = jnp.bfloat16 if cfg.precision == "bf16" else jnp.float32
+    if cfg.model == "transformer":
+        return get_model("transformer", cfg.num_classes,
+                         vocab=vocab_size or 30522, maxlen=cfg.seq_len,
+                         n_layers=cfg.n_layers, d_model=cfg.d_model,
+                         d_ff=cfg.d_ff, h=cfg.n_heads,
+                         alpha=cfg.alpha if cfg.alpha > 0 else 0.99,
+                         dtype=dtype, remat=cfg.remat)
+    return get_model(cfg.model, cfg.num_classes, dtype=dtype,
+                     remat=cfg.remat)
+
+
+def make_loaders(cfg: TrainConfig, train_ds, eval_ds
+                 ) -> Tuple[Callable, Callable, int]:
+    """(train_loader(epoch), eval_loader(epoch), steps_per_epoch).
+
+    cfg.batch_size is the GLOBAL batch: each host loads batch_size /
+    process_count samples and make_array_from_process_local_data
+    assembles the global array (DistributedSampler semantics,
+    resnet50_test.py:331)."""
+    import jax
+
+    from faster_distributed_training_tpu.data import (BatchLoader,
+                                                      PrefetchIterator)
+
+    pc = jax.process_count()
+    if cfg.batch_size % pc:
+        raise ValueError(f"global batch {cfg.batch_size} not divisible by "
+                         f"{pc} processes")
+    local_bs = cfg.batch_size // pc
+
+    def train_loader(epoch: int):
+        return PrefetchIterator(
+            BatchLoader(train_ds, local_bs, epoch=epoch, seed=cfg.seed,
+                        shuffle=True, max_len=cfg.seq_len),
+            depth=cfg.prefetch_depth)
+
+    def eval_loader(epoch: int):
+        return PrefetchIterator(
+            BatchLoader(eval_ds, local_bs, epoch=0, seed=cfg.seed,
+                        shuffle=False, max_len=cfg.seq_len),
+            depth=cfg.prefetch_depth)
+
+    steps = len(BatchLoader(train_ds, local_bs))
+    return train_loader, eval_loader, max(steps, 1)
+
+
+def run_training(cfg: TrainConfig,
+                 log: Callable[[str], None] = print) -> dict:
+    """Full training run; returns {'state','history','best_acc','cfg'}."""
+    setup_platform(cfg)
+
+    import jax
+    import jax.numpy as jnp
+
+    from faster_distributed_training_tpu.data.augment import augment_batch
+    from faster_distributed_training_tpu.optim import build_optimizer
+    from faster_distributed_training_tpu.parallel import (
+        initialize_distributed, make_mesh)
+    from faster_distributed_training_tpu.parallel.placement import (
+        dp_size, make_put_batch, shard_train_state)
+    from faster_distributed_training_tpu.train import (Trainer,
+                                                       create_train_state,
+                                                       init_meta_lambda)
+    from faster_distributed_training_tpu.utils.plotting import draw_graph
+    from faster_distributed_training_tpu.utils.profiling import trace_profile
+
+    if cfg.distributed:
+        initialize_distributed()
+
+    mesh = make_mesh(cfg.mesh_axes, cfg.mesh_shape)
+    is_text = cfg.model == "transformer"
+
+    train_ds = apply_subset(load_dataset(cfg, train=True), cfg.subset_stride)
+    eval_ds = load_dataset(cfg, train=False)
+    vocab = train_ds.vocab_size() if is_text else None
+    model = build_model(cfg, vocab_size=vocab)
+
+    train_loader, eval_loader, steps_per_epoch = make_loaders(
+        cfg, train_ds, eval_ds)
+
+    # xN LR scaling: actual DP world size, not the reference's hard-coded
+    # x4 (resnet50_test.py:482-483).
+    tx, _ = build_optimizer(cfg, steps_per_epoch,
+                            lr_scale=float(dp_size(mesh))
+                            if cfg.distributed or dp_size(mesh) > 1 else 1.0)
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    if is_text:
+        sample = jnp.zeros((cfg.batch_size, cfg.seq_len), jnp.int32)
+        extra = None
+    else:
+        sample = jnp.zeros((cfg.batch_size, 32, 32, 3), jnp.float32)
+        extra = ({"mixup_lambda": init_meta_lambda(rng, cfg.batch_size)}
+                 if cfg.meta_learning else None)
+    state = create_train_state(model, tx, sample, rng,
+                               init_kwargs={"train": True},
+                               extra_params=extra)
+    state = shard_train_state(state, mesh, cfg)
+
+    # device-side augmentation folded into batch staging (train only);
+    # the key advances per put so every batch sees fresh augmentation.
+    aug_counter = [0]
+    aug_key = jax.random.PRNGKey(cfg.seed + 1)
+    aug = jax.jit(augment_batch, static_argnames=("train",))
+
+    def train_augment(batch):
+        if is_text or "image" not in batch:
+            return batch
+        aug_counter[0] += 1
+        k = jax.random.fold_in(aug_key, aug_counter[0])
+        return {**batch, "image": aug(k, batch["image"], train=True)}
+
+    def eval_augment(batch):
+        if is_text or "image" not in batch:
+            return batch
+        return {**batch, "image": aug(aug_key, batch["image"], train=False)}
+
+    put_train = make_put_batch(mesh, train_augment)
+    put_eval = make_put_batch(mesh, eval_augment)
+
+    ckpt_name = "transformer" if is_text else "resnet"
+    with mesh:
+        trainer = Trainer(cfg, put_batch=put_train, log=log)
+        trainer_eval_put = put_eval   # eval uses normalize-only staging
+        state, start_epoch = trainer.maybe_resume(state, ckpt_name)
+
+        # Trainer.put_batch applies to both train and eval; swap for eval
+        # by wrapping evaluate.
+        orig_evaluate = trainer.evaluate
+
+        def evaluate(st, loader):
+            trainer.put_batch = trainer_eval_put
+            try:
+                return orig_evaluate(st, loader)
+            finally:
+                trainer.put_batch = put_train
+
+        trainer.evaluate = evaluate
+
+        with trace_profile("./profile" if cfg.profile else None):
+            state = trainer.fit(state, train_loader, eval_loader,
+                                ckpt_name=ckpt_name, start_epoch=start_epoch)
+
+    if cfg.plot and jax.process_index() == 0 and trainer.history["test_acc"]:
+        prefix = ckpt_name
+        draw_graph(trainer.history["test_acc"], "test accuracy",
+                   f"{prefix} test accuracy", f"{prefix}_accuracy.png")
+        draw_graph(trainer.history["epoch_time"], "seconds",
+                   f"{prefix} epoch time", f"{prefix}_time.png")
+    return {"state": state, "history": trainer.history,
+            "best_acc": trainer.best_acc, "cfg": cfg}
+
+
+def main(argv=None, defaults: Optional[TrainConfig] = None,
+         prog: str = "fdt") -> dict:
+    parser = build_parser(prog=prog, defaults=defaults)
+    args = parser.parse_args(argv)
+    cfg = config_from_args(args, defaults=defaults)
+    return run_training(cfg)

@@ -67,6 +67,12 @@ class TrainConfig:
     seq_buckets: Tuple[int, ...] = (64, 128, 256, 512)
     prefetch_depth: int = 2
 
+    # -- transformer architecture (reference defaults, transformer.py:12-35)
+    n_layers: int = 6
+    d_model: int = 512
+    d_ff: int = 1024
+    n_heads: int = 8
+
     # -- bookkeeping ------------------------------------------------------
     seed: int = 123456                # resnet50_test.py:728
     checkpoint_dir: str = "./checkpoint"
@@ -78,10 +84,14 @@ class TrainConfig:
         return dataclasses.replace(self, **kw)
 
 
-def build_parser(prog: str = "fdt") -> argparse.ArgumentParser:
-    """One argparse surface; flag names match the reference CLI."""
+def build_parser(prog: str = "fdt",
+                 defaults: Optional[TrainConfig] = None
+                 ) -> argparse.ArgumentParser:
+    """One argparse surface; flag names match the reference CLI.  Flag
+    defaults come from `defaults` so each entry point's TrainConfig record
+    (e.g. transformer lr=5e-5) survives unless overridden on the CLI."""
     p = argparse.ArgumentParser(prog=prog, description=__doc__)
-    d = TrainConfig()
+    d = defaults or TrainConfig()
     p.add_argument("--lr", default=d.lr, type=float, help="learning rate")
     p.add_argument("--resume", "-r", action="store_true", help="resume from checkpoint")
     p.add_argument("--epoch", default=d.epochs, type=int, help="number of epochs")
@@ -113,6 +123,12 @@ def build_parser(prog: str = "fdt") -> argparse.ArgumentParser:
     p.add_argument("--checkpoint_dir", default=d.checkpoint_dir, type=str)
     p.add_argument("--profile", action="store_true", help="capture a jax.profiler trace")
     p.add_argument("--no_plot", action="store_true")
+    p.add_argument("--seq_len", default=d.seq_len, type=int,
+                   help="transformer max sequence length")
+    p.add_argument("--n_layers", default=d.n_layers, type=int)
+    p.add_argument("--d_model", default=d.d_model, type=int)
+    p.add_argument("--d_ff", default=d.d_ff, type=int)
+    p.add_argument("--n_heads", default=d.n_heads, type=int)
     return p
 
 
@@ -145,6 +161,8 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         data_dir=args.data_dir, subset_stride=args.subset_stride, seed=args.seed,
         checkpoint_dir=args.checkpoint_dir, profile=args.profile,
         plot=not args.no_plot,
+        seq_len=args.seq_len, n_layers=args.n_layers, d_model=args.d_model,
+        d_ff=args.d_ff, n_heads=args.n_heads,
     )
     if args.model:
         cfg = cfg.replace(model=args.model)

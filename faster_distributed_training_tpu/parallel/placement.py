@@ -42,11 +42,29 @@ def dp_size(mesh: Mesh) -> int:
 
 
 def train_state_shardings(state, mesh: Mesh, cfg: TrainConfig):
-    """A TrainState-shaped pytree of NamedSharding."""
+    """A TrainState-shaped pytree of NamedSharding.
+
+    Model params additionally get tensor-parallel specs wherever a
+    sharding._TP_RULES name rule matches, when the mesh has a tp axis of
+    size > 1 (TP wins over the FSDP spec on matched tensors)."""
     if cfg.fsdp and "fsdp" in mesh.axis_names:
         specs = fsdp_partition_params(state, mesh, axis="fsdp")
     else:
         specs = jax.tree.map(lambda _: P(), state)
+    if "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
+        from faster_distributed_training_tpu.parallel.sharding import (
+            tensor_parallel_rules)
+
+        def overlay(path, spec):
+            name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in path)
+            tp_spec = tensor_parallel_rules(name)
+            return tp_spec if tp_spec != P() else spec
+
+        model_specs = jax.tree_util.tree_map_with_path(
+            overlay, specs.params["model"],
+            is_leaf=lambda x: isinstance(x, P))
+        specs = specs.replace(params={**specs.params, "model": model_specs})
     shardings = jax.tree.map(lambda spec: NamedSharding(mesh, spec), specs,
                              is_leaf=lambda x: isinstance(x, P))
     if cfg.host_offload and _supports_memory_kind(mesh):

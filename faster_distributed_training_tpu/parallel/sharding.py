@@ -83,14 +83,18 @@ def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
 # ---------------------------------------------------------------------------
 
 _TP_RULES = (
-    # attention projections: shard the head (output-feature) dim
-    (r".*attention.*(query|key|value).*kernel", P(None, "tp")),
-    (r".*attention.*out.*kernel", P("tp", None)),
-    # MLP: first linear shards hidden out, second shards hidden in
-    (r".*(ffn|mlp).*(fc1|wi|dense1).*kernel", P(None, "tp")),
-    (r".*(ffn|mlp).*(fc2|wo|dense2).*kernel", P("tp", None)),
-    # embeddings: shard vocab
-    (r".*embed.*embedding", P("tp", None)),
+    # attention projections: shard the head (output-feature) dim.
+    # Patterns match models/transformer.py param paths (attn_N/query/kernel …)
+    # plus common hf/flax spellings.
+    (r".*(attn|attention).*/(query|key|value)/kernel", P(None, "tp")),
+    (r".*(attn|attention).*/(query|key|value)/bias", P("tp")),
+    (r".*(attn|attention).*/out/kernel", P("tp", None)),
+    # MLP: first linear shards hidden out (+bias), second shards hidden in
+    (r".*(ffn|mlp).*/(dense_0|fc1|wi)/kernel", P(None, "tp")),
+    (r".*(ffn|mlp).*/(dense_0|fc1|wi)/bias", P("tp")),
+    (r".*(ffn|mlp).*/(dense_1|fc2|wo)/kernel", P("tp", None)),
+    # embeddings: shard the vocab dim of the token table only
+    (r".*token_embedding", P("tp", None)),
 )
 
 

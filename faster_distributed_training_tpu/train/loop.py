@@ -52,7 +52,12 @@ class Trainer:
             state, metrics = self.train_step(state, self.put_batch(batch))
             acc.add(metrics)
         if metrics is not None:
-            jax.block_until_ready(metrics["loss"])
+            # fence with a device->host readback: on some PJRT backends
+            # block_until_ready returns at dispatch, not completion
+            # (.claude/skills/verify/SKILL.md), which would make the
+            # reference-parity epoch timing (resnet50_test.py:519,614)
+            # meaninglessly small.
+            float(metrics["loss"])
         elapsed = time.monotonic() - t0
         return state, acc.summary(), elapsed
 

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""ResNet-50 / CIFAR-10 training entry — the reference's resnet50_test.py
+re-expressed over the TPU-native framework.
+
+Keeps the reference flag surface (--bs --lr --epoch --alpha --workers
+--meta_learning --distributed --ngd --resume, resnet50_test.py:46-59) and
+adds --device/--mesh/--fsdp/--precision.  Examples:
+
+  python resnet50_test.py --bs 64                       # SGD-era baseline
+  python resnet50_test.py --bs 1024 --ngd --meta_learning
+  python resnet50_test.py --dataset synthetic --epoch 1 --device cpu
+"""
+
+from faster_distributed_training_tpu.cli import main
+from faster_distributed_training_tpu.config import TrainConfig
+
+DEFAULTS = TrainConfig(model="resnet50", dataset="cifar10", num_classes=10,
+                       lr=0.1, batch_size=512, epochs=30, alpha=0.2)
+
+if __name__ == "__main__":
+    result = main(defaults=DEFAULTS, prog="resnet50_test")
+    print(f"best test accuracy: {result['best_acc']:.4f}")

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Multi-host launch — the torchrun replacement (reference run_distributed.sh:2-3).
+#
+# TPU model: ONE process per host sees all local chips; hosts rendezvous via
+# jax.distributed.initialize.  On a single host this collapses to a plain
+# invocation (all chips already visible) — no process-per-device spawning.
+#
+# Multi-host usage (run on every host, e.g. via gcloud ... --worker=all):
+#   FDT_COORDINATOR=<host0>:8476 FDT_NUM_PROCESSES=<n> FDT_PROCESS_ID=<i> \
+#     bash run_distributed.sh
+set -euo pipefail
+
+DIST_FLAGS=""
+if [[ "${FDT_NUM_PROCESSES:-1}" -gt 1 ]]; then
+  DIST_FLAGS="--distributed"
+fi
+
+python resnet50_test.py ${DIST_FLAGS} --bs 256 --lr 0.01 --meta_learning --ngd "$@"
+python transformer_test.py ${DIST_FLAGS} --bs 64 --ngd "$@"

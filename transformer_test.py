@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Transformer / AG News text-classification entry — the reference's
+transformer_test.py re-expressed over the TPU-native framework.
+
+Reference flags preserved (transformer_test.py:350-361: --batch_size/-b,
+--epoch, --lr, --resume, --workers, --alpha, --distributed, --ngd).
+Examples:
+
+  python transformer_test.py -b 64 --ngd
+  python transformer_test.py --dataset synthetic --epoch 1 --device cpu
+"""
+
+from faster_distributed_training_tpu.cli import main
+from faster_distributed_training_tpu.config import TrainConfig
+
+DEFAULTS = TrainConfig(model="transformer", dataset="agnews", num_classes=4,
+                       lr=5e-5, batch_size=16, epochs=30, alpha=0.99,
+                       seq_len=512)
+
+if __name__ == "__main__":
+    result = main(defaults=DEFAULTS, prog="transformer_test")
+    print(f"best test accuracy: {result['best_acc']:.4f}")
