@@ -26,7 +26,11 @@ from faster_distributed_training_tpu.config import (TrainConfig,
 
 def setup_platform(cfg: TrainConfig) -> None:
     """Select the JAX platform before first backend use.  `auto` keeps
-    whatever the environment provides (TPU when available)."""
+    whatever the environment provides (TPU when available).  On cpu, a
+    mesh larger than the physical device count gets virtual devices
+    (the multi-chip simulation used by tests, SURVEY.md §4)."""
+    import numpy as np
+
     import jax
 
     if cfg.device != "auto":
@@ -35,6 +39,12 @@ def setup_platform(cfg: TrainConfig) -> None:
             jax.config.update("jax_platforms", want)
         except Exception:
             os.environ["JAX_PLATFORMS"] = want
+        need = int(np.prod(cfg.mesh_shape)) if cfg.mesh_shape else 1
+        if want == "cpu" and need > 1:
+            try:
+                jax.config.update("jax_num_cpu_devices", need)
+            except Exception:
+                pass  # backend already initialized; make_mesh will report
 
 
 def load_dataset(cfg: TrainConfig, train: bool):
@@ -73,15 +83,18 @@ def load_dataset(cfg: TrainConfig, train: bool):
                                seed=0 if train else 1)
     else:
         raise ValueError(f"unknown dataset {cfg.dataset!r}")
-    if cfg.subset_stride > 1:   # tuning harness: 1/N stride subset
-        x, y = x[::cfg.subset_stride], y[::cfg.subset_stride]
     return (x, y)
 
 
 def apply_subset(ds, stride: int):
-    """Stride-subset for text datasets (tuning/transformer_tuning.py:89-90)."""
-    if stride <= 1 or isinstance(ds, tuple):
+    """1/N-stride subset of either dataset kind — applied to BOTH splits,
+    matching the reference tuning harness (tuning/resnet50_tuning.py:328,346
+    subsets train and test alike)."""
+    if stride <= 1:
         return ds
+    if isinstance(ds, tuple):
+        x, y = ds
+        return (x[::stride], y[::stride])
 
     class _Subset:
         def __init__(self, base):
@@ -104,17 +117,33 @@ def apply_subset(ds, stride: int):
     return _Subset(ds)
 
 
-def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None):
+def resolve_attention(cfg: TrainConfig, mesh=None) -> str:
+    """'' auto-resolves: ring when the mesh has an sp axis of size > 1,
+    flash on TPU, dense otherwise."""
+    if cfg.attention:
+        return cfg.attention
+    if (mesh is not None and "sp" in mesh.axis_names
+            and mesh.shape["sp"] > 1):
+        return "ring"
+    import jax
+    return "flash" if jax.default_backend() == "tpu" else "dense"
+
+
+def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
+                mesh=None):
     import jax.numpy as jnp
 
     from faster_distributed_training_tpu.models import get_model
 
     dtype = jnp.bfloat16 if cfg.precision == "bf16" else jnp.float32
     if cfg.model == "transformer":
+        impl = resolve_attention(cfg, mesh)
         return get_model("transformer", cfg.num_classes,
                          vocab=vocab_size or 30522, maxlen=cfg.seq_len,
                          n_layers=cfg.n_layers, d_model=cfg.d_model,
                          d_ff=cfg.d_ff, h=cfg.n_heads,
+                         attention_impl=impl,
+                         mesh=mesh if impl == "ring" else None,
                          alpha=cfg.alpha if cfg.alpha > 0 else 0.99,
                          dtype=dtype, remat=cfg.remat)
     return get_model(cfg.model, cfg.num_classes, dtype=dtype,
@@ -183,9 +212,9 @@ def run_training(cfg: TrainConfig,
     is_text = cfg.model == "transformer"
 
     train_ds = apply_subset(load_dataset(cfg, train=True), cfg.subset_stride)
-    eval_ds = load_dataset(cfg, train=False)
+    eval_ds = apply_subset(load_dataset(cfg, train=False), cfg.subset_stride)
     vocab = train_ds.vocab_size() if is_text else None
-    model = build_model(cfg, vocab_size=vocab)
+    model = build_model(cfg, vocab_size=vocab, mesh=mesh)
 
     train_loader, eval_loader, steps_per_epoch = make_loaders(
         cfg, train_ds, eval_ds)
@@ -193,8 +222,7 @@ def run_training(cfg: TrainConfig,
     # xN LR scaling: actual DP world size, not the reference's hard-coded
     # x4 (resnet50_test.py:482-483).
     tx, _ = build_optimizer(cfg, steps_per_epoch,
-                            lr_scale=float(dp_size(mesh))
-                            if cfg.distributed or dp_size(mesh) > 1 else 1.0)
+                            lr_scale=float(dp_size(mesh)))
 
     rng = jax.random.PRNGKey(cfg.seed)
     if is_text:
@@ -232,23 +260,9 @@ def run_training(cfg: TrainConfig,
 
     ckpt_name = "transformer" if is_text else "resnet"
     with mesh:
-        trainer = Trainer(cfg, put_batch=put_train, log=log)
-        trainer_eval_put = put_eval   # eval uses normalize-only staging
+        trainer = Trainer(cfg, put_batch=put_train,
+                          put_eval_batch=put_eval, log=log)
         state, start_epoch = trainer.maybe_resume(state, ckpt_name)
-
-        # Trainer.put_batch applies to both train and eval; swap for eval
-        # by wrapping evaluate.
-        orig_evaluate = trainer.evaluate
-
-        def evaluate(st, loader):
-            trainer.put_batch = trainer_eval_put
-            try:
-                return orig_evaluate(st, loader)
-            finally:
-                trainer.put_batch = put_train
-
-        trainer.evaluate = evaluate
-
         with trace_profile("./profile" if cfg.profile else None):
             state = trainer.fit(state, train_loader, eval_loader,
                                 ckpt_name=ckpt_name, start_epoch=start_epoch)

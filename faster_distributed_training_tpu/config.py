@@ -72,6 +72,7 @@ class TrainConfig:
     d_model: int = 512
     d_ff: int = 1024
     n_heads: int = 8
+    attention: str = ""               # "" auto | dense | flash | ring
 
     # -- bookkeeping ------------------------------------------------------
     seed: int = 123456                # resnet50_test.py:728
@@ -129,6 +130,10 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--d_model", default=d.d_model, type=int)
     p.add_argument("--d_ff", default=d.d_ff, type=int)
     p.add_argument("--n_heads", default=d.n_heads, type=int)
+    p.add_argument("--attention", default=d.attention,
+                   choices=["", "dense", "flash", "ring"],
+                   help="attention impl ('' = ring when the mesh has an sp "
+                        "axis, flash on TPU, else dense)")
     return p
 
 
@@ -162,7 +167,7 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         checkpoint_dir=args.checkpoint_dir, profile=args.profile,
         plot=not args.no_plot,
         seq_len=args.seq_len, n_layers=args.n_layers, d_model=args.d_model,
-        d_ff=args.d_ff, n_heads=args.n_heads,
+        d_ff=args.d_ff, n_heads=args.n_heads, attention=args.attention,
     )
     if args.model:
         cfg = cfg.replace(model=args.model)

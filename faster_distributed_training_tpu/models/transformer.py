@@ -112,13 +112,24 @@ def dense_attention(q, k, v, mask, dropout_rate, deterministic, dropout_rng):
 
 
 class MultiheadAttention(nn.Module):
-    """transformer.py:196-227 — 3 full-width projections + output proj."""
+    """transformer.py:196-227 — 3 full-width projections + output proj.
+
+    attention_impl selects the context computation:
+      dense — O(L²) ScaledDotProduct with prob dropout (the reference);
+      flash — Pallas TPU kernel / blockwise fallback (ops/flash_attention);
+      ring  — sequence-parallel ring attention over `sp_axis` of `mesh`
+              (ops/ring_attention).  flash/ring never materialize the
+              probability tensor, so attention-prob dropout is skipped
+              there by construction.
+    """
     h: int
     d_model: int
     dropout: float = 0.1
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
-    attention_impl: str = "dense"     # dense | flash
+    attention_impl: str = "dense"     # dense | flash | ring
+    mesh: Optional[Any] = None        # required for ring
+    sp_axis: str = "sp"
 
     @nn.compact
     def __call__(self, x: jax.Array, mask: Optional[jax.Array],
@@ -135,6 +146,14 @@ class MultiheadAttention(nn.Module):
             from faster_distributed_training_tpu.ops.flash_attention import (
                 flash_attention)
             ctx = flash_attention(q, k, v, mask=mask)
+        elif self.attention_impl == "ring":
+            from faster_distributed_training_tpu.ops.ring_attention import (
+                ring_self_attention)
+            if self.mesh is None:
+                raise ValueError("attention_impl='ring' needs a mesh with "
+                                 f"an {self.sp_axis!r} axis")
+            ctx = ring_self_attention(q, k, v, mask, self.mesh,
+                                      sp_axis=self.sp_axis)
         else:
             rng = (self.make_rng("dropout")
                    if (self.dropout > 0 and train) else None)
@@ -181,7 +200,9 @@ class Transformer(nn.Module):
     alpha: float = 0.99           # in-forward mixup Beta parameter
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
-    attention_impl: str = "dense"
+    attention_impl: str = "dense"  # dense | flash | ring
+    mesh: Optional[Any] = None     # required for attention_impl='ring'
+    sp_axis: str = "sp"
     remat: bool = False
 
     @nn.compact
@@ -211,7 +232,8 @@ class Transformer(nn.Module):
             a = ln(f"ln_attn_{i}")(h)
             a = MultiheadAttention(self.h, self.d_model, self.dropout_attention,
                                    self.dtype, self.param_dtype,
-                                   self.attention_impl,
+                                   self.attention_impl, self.mesh,
+                                   self.sp_axis,
                                    name=f"attn_{i}")(a, mask, train)
             a = nn.Dropout(self.dropout_connection_attention,
                            deterministic=not train)(a)

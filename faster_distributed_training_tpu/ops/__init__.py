@@ -11,3 +11,9 @@ from faster_distributed_training_tpu.ops.conv_bn import (  # noqa: F401
     conv2d, fused_conv_bn, conv_bn_reference)
 from faster_distributed_training_tpu.ops.fused_mlp import (  # noqa: F401
     fused_mlp, mlp_reference)
+from faster_distributed_training_tpu.ops.attention import (  # noqa: F401
+    blockwise_attention, dense_attention_reference)
+from faster_distributed_training_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention)
+from faster_distributed_training_tpu.ops.ring_attention import (  # noqa: F401
+    ring_attention, ring_self_attention)

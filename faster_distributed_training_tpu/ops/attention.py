@@ -1,0 +1,131 @@
+"""Blockwise (online-softmax / flash-style) attention in pure JAX.
+
+The reference's attention is the O(L²)-memory dense ScaledDotProduct
+(transformer.py:180-193): it materializes the full [B,H,Lq,Lk] score and
+probability tensors.  Blockwise attention streams over key/value blocks
+with running (max, sum, accumulator) statistics, so peak memory is
+O(Lq·block_k) — this is the long-context enabler and the shared math for
+both the Pallas TPU kernel (ops/flash_attention.py) and ring
+sequence-parallel attention (ops/ring_attention.py).
+
+Mask convention matches models/transformer.py: mask==0 → masked out,
+broadcastable to [B, H, Lq, Lk] (typically a [B,1,1,Lk] padding mask).
+Softmax statistics are kept in fp32 regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e9  # matches models/transformer.py masking constant
+
+
+def mask_to_bias(mask: Optional[jax.Array], dtype=jnp.float32
+                 ) -> Optional[jax.Array]:
+    """mask (…==0 masked) -> additive bias (0 keep, NEG_INF drop)."""
+    if mask is None:
+        return None
+    return jnp.where(mask == 0, jnp.asarray(NEG_INF, dtype),
+                     jnp.asarray(0.0, dtype))
+
+
+def online_block_update(q: jax.Array, k_blk: jax.Array, v_blk: jax.Array,
+                        bias_blk: Optional[jax.Array],
+                        m: jax.Array, l: jax.Array, acc: jax.Array,
+                        scale: float) -> Tuple[jax.Array, jax.Array,
+                                               jax.Array]:
+    """One online-softmax accumulation step.
+
+    q [..., Lq, D], k_blk/v_blk [..., Bk, D], bias_blk broadcastable to
+    [..., Lq, Bk]; m/l [..., Lq] fp32 running max / normalizer,
+    acc [..., Lq, D] fp32 running numerator.  Returns updated (m, l, acc).
+    """
+    s = jnp.einsum("...qd,...kd->...qk", q, k_blk,
+                   preferred_element_type=jnp.float32) * scale
+    if bias_blk is not None:
+        s = s + bias_blk.astype(jnp.float32)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # exp(NEG_INF - m_new) underflows to 0, so fully-masked columns drop out
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def finalize(m: jax.Array, l: jax.Array, acc: jax.Array,
+             dtype) -> jax.Array:
+    """acc / l with fully-masked-row protection (returns 0 there)."""
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(dtype)
+
+
+def init_carry(q: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    lead = q.shape[:-1]          # [..., Lq]
+    m = jnp.full(lead, -jnp.inf, jnp.float32)
+    l = jnp.zeros(lead, jnp.float32)
+    acc = jnp.zeros(q.shape[:-1] + (q.shape[-1],), jnp.float32)
+    return m, l, acc
+
+
+@partial(jax.jit, static_argnames=("block_k",))
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        mask: Optional[jax.Array] = None,
+                        block_k: int = 128) -> jax.Array:
+    """Streaming attention over key blocks via lax.scan.
+
+    q [B,H,Lq,D], k/v [B,H,Lk,D], mask broadcastable to [B,H,Lq,Lk]
+    (mask==0 masked).  Numerically equal to dense softmax attention.
+    """
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    scale = 1.0 / math.sqrt(D)
+    block_k = min(block_k, Lk)
+    n_blocks = -(-Lk // block_k)
+    pad = n_blocks * block_k - Lk
+
+    bias = mask_to_bias(mask)
+    if bias is None:
+        bias = jnp.zeros((1, 1, 1, Lk), jnp.float32)
+    bias = jnp.broadcast_to(bias, (B,) + bias.shape[1:3] + (Lk,))
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        bias = jnp.pad(bias, ((0, 0), (0, 0), (0, 0), (0, pad)),
+                       constant_values=NEG_INF)
+
+    # [n, B, H, block, D] blocks as scan sequence
+    kb = jnp.moveaxis(k.reshape(B, H, n_blocks, block_k, D), 2, 0)
+    vb = jnp.moveaxis(v.reshape(B, H, n_blocks, block_k, D), 2, 0)
+    bb = jnp.moveaxis(
+        bias.reshape(B, bias.shape[1], bias.shape[2], n_blocks, block_k),
+        3, 0)
+
+    def body(carry, blk):
+        m, l, acc = carry
+        k_blk, v_blk, bias_blk = blk
+        return online_block_update(q, k_blk, v_blk, bias_blk, m, l, acc,
+                                   scale), None
+
+    (m, l, acc), _ = lax.scan(body, init_carry(q), (kb, vb, bb))
+    return finalize(m, l, acc, q.dtype)
+
+
+def dense_attention_reference(q, k, v, mask=None):
+    """O(L²) reference (transformer.py:180-193 semantics) for tests."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    bias = mask_to_bias(mask)
+    if bias is not None:
+        s = s + bias
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)

@@ -1,0 +1,146 @@
+"""Flash attention: Pallas TPU forward kernel + recompute backward.
+
+TPU-first replacement for the reference's dense ScaledDotProduct
+(transformer.py:180-193).  Design:
+
+  * forward — a Pallas kernel tiled (batch·head, query-block) with K/V
+    resident in VMEM: one MXU matmul for scores, row-softmax in fp32,
+    one MXU matmul for the context.  Probabilities never touch HBM.
+  * backward — recompute-in-backward (the same memory trick as the
+    reference's FusedConvBN, resnet.py:107-108): residuals are just
+    (q, k, v, mask); gradients come from the VJP of the blockwise
+    implementation, so peak memory stays O(L·block) in both passes.
+  * non-TPU backends (tests, CPU sim) use the blockwise path; set
+    FDT_FORCE_PALLAS_INTERPRET=1 to exercise the kernel in interpreter
+    mode on CPU.
+
+Per-head K/V for supported workloads fits VMEM comfortably (e.g.
+L=512, D=64, fp32 → 128 KiB per tensor of the ~16 MiB budget); longer
+sequences shard L over the `sp` mesh axis first (ops/ring_attention.py),
+so each shard stays VMEM-sized.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from faster_distributed_training_tpu.ops.attention import (
+    NEG_INF, blockwise_attention, mask_to_bias)
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("FDT_FORCE_PALLAS_INTERPRET") == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _flash_fwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
+                      key_bias: Optional[jax.Array],
+                      block_q: int) -> jax.Array:
+    """q/k/v [N, L, D] (N = B·H), key_bias [N, Lk] additive or None."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    N, Lq, D = q.shape
+    Lk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    block_q = min(block_q, Lq)
+    nq = -(-Lq // block_q)
+    pad_q = nq * block_q - Lq
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0)))
+    if key_bias is None:
+        key_bias = jnp.zeros((N, Lk), jnp.float32)
+    key_bias = key_bias.reshape(N, 1, Lk).astype(jnp.float32)
+
+    def kernel(q_ref, k_ref, v_ref, b_ref, o_ref):
+        qb = q_ref[0]                                   # [block_q, D]
+        s = jax.lax.dot_general(
+            qb, k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [block_q, Lk]
+        s = s + b_ref[0]
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+        ctx = jnp.dot(p.astype(v_ref.dtype), v_ref[0],
+                      preferred_element_type=jnp.float32)
+        o_ref[0] = (ctx / l).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(N, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda n, i: (n, i, 0)),
+            pl.BlockSpec((1, Lk, D), lambda n, i: (n, 0, 0)),
+            pl.BlockSpec((1, Lk, D), lambda n, i: (n, 0, 0)),
+            pl.BlockSpec((1, 1, Lk), lambda n, i: (n, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda n, i: (n, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, nq * block_q, D), q.dtype),
+        interpret=(jax.default_backend() != "tpu"),
+    )(q, k, v, key_bias)
+    return out[:, :Lq, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flash_core(q, k, v, key_bias, block_q):
+    return _flash_impl(q, k, v, key_bias, block_q)
+
+
+def _flash_impl(q, k, v, key_bias, block_q):
+    B, H, Lq, D = q.shape
+    if _use_pallas():
+        nq = lambda x: x.reshape(B * H, x.shape[2], x.shape[3])  # noqa: E731
+        kb = (jnp.repeat(key_bias, H, axis=0)
+              if key_bias is not None else None)
+        out = _flash_fwd_pallas(nq(q), nq(k), nq(v), kb, block_q)
+        return out.reshape(B, H, Lq, D)
+    mask = None
+    if key_bias is not None:
+        mask = (key_bias > NEG_INF / 2).astype(jnp.int32)[:, None, None, :]
+    return blockwise_attention(q, k, v, mask)
+
+
+def _flash_fwd(q, k, v, key_bias, block_q):
+    return _flash_core(q, k, v, key_bias, block_q), (q, k, v, key_bias)
+
+
+def _flash_bwd(block_q, res, g):
+    q, k, v, key_bias = res
+    mask = None
+    if key_bias is not None:
+        mask = (key_bias > NEG_INF / 2).astype(jnp.int32)[:, None, None, :]
+    # recompute-in-backward: differentiate the blockwise formulation
+    _, vjp = jax.vjp(lambda q_, k_, v_: blockwise_attention(q_, k_, v_, mask),
+                     q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    mask: Optional[jax.Array] = None,
+                    block_q: int = 128) -> jax.Array:
+    """Drop-in for dense_attention (models/transformer.py:101-111), minus
+    attention-prob dropout (probabilities are never materialized).
+
+    q/k/v: [B, H, L, D].  mask: None or a key-padding mask broadcastable
+    to [B, 1, 1, Lk] (mask==0 masked) — full [B,H,Lq,Lk] masks should use
+    blockwise_attention directly.
+    """
+    key_bias = None
+    if mask is not None:
+        kb = jnp.asarray(mask)
+        if kb.ndim == 4:                     # [B,1,1,Lk] -> [B,Lk]
+            kb = kb.reshape(kb.shape[0], kb.shape[-1])
+        kb = jnp.broadcast_to(kb, (q.shape[0], k.shape[2]))
+        key_bias = mask_to_bias(kb)
+    return _flash_core(q, k, v, key_bias, block_q)

@@ -1,0 +1,123 @@
+"""Ring attention: sequence/context parallelism over an `sp` mesh axis.
+
+The reference caps sequence length at 512 and computes O(L²) dense
+attention on one device (transformer.py:35,180-193).  Here the sequence
+dimension is sharded over the mesh's `sp` axis and K/V shards rotate
+around the ring with `lax.ppermute` while each device accumulates
+online-softmax statistics for its resident Q shard — attention memory
+per device is O(L·L/sp) and the K/V transfers ride the ICI ring,
+overlapping with the block computation.  This is the blockwise/ring
+attention construction of Liu et al. (Ring Attention with Blockwise
+Transformers), built from the same `online_block_update` primitive as
+ops/attention.py so the math provably matches dense attention.
+
+Gradients flow through `ppermute` (its transpose is the reverse
+rotation), so the backward pass is ring-parallel too; the scan body is
+`jax.checkpoint`-ed, keeping residual memory at one K/V shard per step.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from faster_distributed_training_tpu.ops.attention import (
+    NEG_INF, finalize, mask_to_bias, online_block_update)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str,
+                   key_bias: Optional[jax.Array] = None,
+                   causal: bool = False) -> jax.Array:
+    """Ring attention body — call INSIDE shard_map, sequence sharded on
+    `axis_name`.
+
+    q/k/v: [B, H, L_local, D] (this device's sequence shard),
+    key_bias: [B, L_local] additive key bias (0 keep / NEG_INF drop) for
+    this shard's keys, or None.  Returns [B, H, L_local, D].
+    """
+    B, H, L, D = q.shape
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    scale = 1.0 / math.sqrt(D)
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+    if key_bias is None:
+        key_bias = jnp.zeros((B, L), jnp.float32)
+
+    pos = jnp.arange(L, dtype=jnp.int32)
+
+    @jax.checkpoint
+    def body(carry, _):
+        k_cur, v_cur, b_cur, src, m, l, acc = carry
+        bias = b_cur[:, None, None, :]                    # [B,1,1,L]
+        if causal:
+            q_pos = idx * L + pos                         # global positions
+            k_pos = src * L + pos
+            bias = bias + jnp.where(k_pos[None, :] <= q_pos[:, None],
+                                    0.0, NEG_INF)[None, None]
+        m, l, acc = online_block_update(q, k_cur, v_cur, bias, m, l, acc,
+                                        scale)
+        # rotate the K/V shard to the next rank; XLA overlaps the ICI
+        # transfer with the next step's matmuls where possible
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        b_cur = lax.ppermute(b_cur, axis_name, perm)
+        return (k_cur, v_cur, b_cur, (src - 1) % sp, m, l, acc), None
+
+    # derive the fresh accumulators from q so they carry q's full
+    # varying-manual-axes set (dp AND sp), keeping scan carry types stable
+    # under shard_map's VMA checking regardless of the surrounding mesh
+    zero_rows = q[..., 0].astype(jnp.float32) * 0.0
+    m0 = zero_rows - jnp.inf
+    l0 = zero_rows
+    acc0 = q.astype(jnp.float32) * 0.0
+    carry0 = (k, v, key_bias.astype(jnp.float32) + zero_rows[:, 0, :] * 0.0,
+              idx, m0, l0, acc0)
+    (_, _, _, _, m, l, acc), _ = lax.scan(body, carry0, None, length=sp)
+    return finalize(m, l, acc, q.dtype)
+
+
+def _batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+
+
+def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        mask: Optional[jax.Array], mesh: Mesh,
+                        sp_axis: str = "sp",
+                        causal: bool = False) -> jax.Array:
+    """shard_map wrapper: globally-shaped [B,H,L,D] in and out, with L
+    sharded over `sp_axis` and B over the data axes.
+
+    mask: None, [B, L], or [B,1,1,L] key-padding mask (mask==0 masked)."""
+    B, H, L, D = q.shape
+    batch = _batch_axes(mesh)
+    lead = batch if len(batch) != 1 else batch[0]
+    # heads are embarrassingly parallel: split them over tp when present
+    head = ("tp" if "tp" in mesh.axis_names and mesh.shape["tp"] > 1
+            and H % mesh.shape["tp"] == 0 else None)
+    qkv_spec = P(lead, head, sp_axis, None)
+    bias_spec = P(lead, sp_axis)
+
+    key_bias = None
+    if mask is not None:
+        mask = jnp.asarray(mask)
+        if mask.ndim == 4:
+            mask = mask.reshape(B, mask.shape[-1])
+        key_bias = mask_to_bias(mask)
+
+    fn = partial(ring_attention, axis_name=sp_axis, causal=causal)
+    if key_bias is None:
+        return jax.shard_map(
+            lambda q_, k_, v_: fn(q_, k_, v_),
+            mesh=mesh, in_specs=(qkv_spec,) * 3,
+            out_specs=qkv_spec)(q, k, v)
+    return jax.shard_map(
+        lambda q_, k_, v_, b_: fn(q_, k_, v_, key_bias=b_),
+        mesh=mesh, in_specs=(qkv_spec,) * 3 + (bias_spec,),
+        out_specs=qkv_spec)(q, k, v, key_bias)

@@ -53,12 +53,10 @@ def train_state_shardings(state, mesh: Mesh, cfg: TrainConfig):
         specs = jax.tree.map(lambda _: P(), state)
     if "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
         from faster_distributed_training_tpu.parallel.sharding import (
-            tensor_parallel_rules)
+            param_path_name, tensor_parallel_rules)
 
         def overlay(path, spec):
-            name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                            for k in path)
-            tp_spec = tensor_parallel_rules(name)
+            tp_spec = tensor_parallel_rules(param_path_name(path))
             return tp_spec if tp_spec != P() else spec
 
         model_specs = jax.tree_util.tree_map_with_path(

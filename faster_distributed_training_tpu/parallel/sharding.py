@@ -98,6 +98,13 @@ _TP_RULES = (
 )
 
 
+def param_path_name(path) -> str:
+    """'/'-joined name for a tree_map_with_path key path — THE framework
+    convention for matching param names against sharding rules."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
 def tensor_parallel_rules(flat_name: str) -> P:
     """Map a '/'-joined param path to a TP PartitionSpec (P() if no rule hits)."""
     low = flat_name.lower()
@@ -113,7 +120,6 @@ def apply_tp_rules(params: Any, mesh: Mesh) -> Any:
         return jax.tree.map(lambda _: P(), params)
 
     def lookup(path, _):
-        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-        return tensor_parallel_rules(name)
+        return tensor_parallel_rules(param_path_name(path))
 
     return jax.tree_util.tree_map_with_path(lookup, params)

@@ -33,9 +33,13 @@ class Trainer:
     """Owns the compiled steps and the epoch loop."""
 
     def __init__(self, cfg: TrainConfig, put_batch: Optional[Callable] = None,
+                 put_eval_batch: Optional[Callable] = None,
                  log: Callable[[str], None] = print):
         self.cfg = cfg
         self.put_batch = put_batch or (lambda b: b)
+        # eval staging may differ (e.g. normalize-only augmentation);
+        # defaults to the train staging function
+        self.put_eval_batch = put_eval_batch or self.put_batch
         self.log = log if jax.process_index() == 0 else (lambda *_: None)
         self.train_step = jax.jit(make_train_step(cfg), donate_argnums=0)
         self.eval_step = jax.jit(make_eval_step(cfg))
@@ -64,7 +68,7 @@ class Trainer:
     def evaluate(self, state: TrainState, loader: Iterable) -> Dict[str, float]:
         acc = MetricAccumulator()
         for batch in loader:
-            acc.add(self.eval_step(state, self.put_batch(batch)))
+            acc.add(self.eval_step(state, self.put_eval_batch(batch)))
         return acc.summary()
 
     def fit(self, state: TrainState, train_loader: LoaderFn,
