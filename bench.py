@@ -10,12 +10,20 @@ is set; otherwise it is emitted as the constant 1.0 with
 Synthetic data (device-resident) so the number measures the compiled train
 step, not disk IO.  The batch is sharded over a dp mesh spanning every
 visible chip, so value is genuine per-chip throughput on multi-chip hosts.
+
+FDT_BENCH_NGD_OVERHEAD=1 additionally reports NGD's step-time overhead vs
+plain SGD (BASELINE.md's second tracked metric).  The SGD run executes in
+a SUBPROCESS: each process builds exactly one donating train program —
+the same program shape the Trainer runs — which also sidesteps the axon
+backend's donated-buffer deallocation bug (.claude/skills/verify/SKILL.md).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -26,10 +34,13 @@ import numpy as np
 BASELINE_REF_IPS = float(os.environ.get("FDT_BENCH_BASELINE", "0") or 0)
 
 
-def main() -> None:
+def timed_run(use_ngd: bool, bs: int, steps: int) -> float:
+    """Build ONE donating train program (the Trainer's exact configuration)
+    and time `steps` executions, fenced by a device->host readback."""
     import jax
     import jax.numpy as jnp
 
+    from faster_distributed_training_tpu.cli import enable_compilation_cache
     from faster_distributed_training_tpu.config import TrainConfig
     from faster_distributed_training_tpu.models import resnet50
     from faster_distributed_training_tpu.optim import build_optimizer
@@ -39,55 +50,74 @@ def main() -> None:
     from faster_distributed_training_tpu.train import (create_train_state,
                                                        make_train_step)
 
-    n_chips = jax.device_count()
+    enable_compilation_cache()
     mesh = make_mesh(("dp",))  # batch sharded over every visible chip
-    bs = int(os.environ.get("FDT_BENCH_BS", "1024"))
-    steps = int(os.environ.get("FDT_BENCH_STEPS", "20"))
-
     cfg = TrainConfig(model="resnet50", batch_size=bs, alpha=0.2,
-                      use_ngd=True, precision="bf16", epochs=1)
+                      use_ngd=use_ngd,
+                      optimizer="ngd" if use_ngd else "sgd",
+                      precision="bf16", epochs=1)
     model = resnet50(num_classes=10)
-    tx, _ = build_optimizer(cfg, steps_per_epoch=steps)
     rng = jax.random.PRNGKey(cfg.seed)
     sample = jnp.zeros((bs, 32, 32, 3), jnp.float32)
+    tx, _ = build_optimizer(cfg, steps_per_epoch=steps)
     state = create_train_state(model, tx, sample, rng,
                                init_kwargs={"train": True})
-
-    rr = np.random.default_rng(0)
     with mesh:
         state = shard_train_state(state, mesh, cfg)
         put = make_put_batch(mesh)
+        rr = np.random.default_rng(0)
         batch = put({
             "image": rr.normal(size=(bs, 32, 32, 3)).astype(np.float32),
             "label": rr.integers(0, 10, size=(bs,)).astype(np.int32),
         })
         step = jax.jit(make_train_step(cfg), donate_argnums=0)
-
         # warmup / compile; fence with a device->host readback — on some
         # PJRT backends block_until_ready returns at dispatch, not
         # completion.
         state, metrics = step(state, batch)
         float(metrics["loss"])
-
         t0 = time.monotonic()
         for _ in range(steps):
             state, metrics = step(state, batch)
         float(metrics["loss"])
-        elapsed = time.monotonic() - t0
+        return time.monotonic() - t0
 
-    ips = bs * steps / elapsed
-    ips_per_chip = ips / max(n_chips, 1)
+
+def main() -> None:
+    import jax
+
+    bs = int(os.environ.get("FDT_BENCH_BS", "1024"))
+    steps = int(os.environ.get("FDT_BENCH_STEPS", "20"))
+
+    if os.environ.get("FDT_BENCH_INTERNAL_SGD") == "1":
+        # child process: print the SGD elapsed time and exit
+        print(json.dumps({"sgd_elapsed": timed_run(False, bs, steps)}))
+        return
+
+    n_chips = jax.device_count()
+    elapsed = timed_run(True, bs, steps)
+    ips_per_chip = bs * steps / elapsed / max(n_chips, 1)
     # vs_baseline: ratio against FDT_BENCH_BASELINE (img/s/chip) when set;
     # 1.0 otherwise = "no external baseline configured" — the absolute value
     # is the tracked metric (the reference publishes no absolute throughput).
     vs = (ips_per_chip / BASELINE_REF_IPS) if BASELINE_REF_IPS else 1.0
-    print(json.dumps({
+    record = {
         "metric": "resnet50_cifar10_train_images_per_sec_per_chip_bs%d" % bs,
         "value": round(ips_per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs, 3),
         "baseline_configured": bool(BASELINE_REF_IPS),
-    }))
+    }
+    if os.environ.get("FDT_BENCH_NGD_OVERHEAD") == "1":
+        env = dict(os.environ, FDT_BENCH_INTERNAL_SGD="1")
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             env=env, capture_output=True, text=True,
+                             timeout=1200)
+        sgd_elapsed = json.loads(out.stdout.strip().splitlines()[-1]
+                                 )["sgd_elapsed"]
+        record["ngd_overhead_pct"] = round(
+            (elapsed - sgd_elapsed) / sgd_elapsed * 100.0, 1)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -24,6 +24,21 @@ from faster_distributed_training_tpu.config import (TrainConfig,
                                                     config_from_args)
 
 
+def enable_compilation_cache(path: str = "") -> None:
+    """Persistent XLA compilation cache — TPU train-step compiles take
+    minutes; cached reloads take seconds (shared across processes, e.g.
+    bench.py's subprocess comparison runs)."""
+    import jax
+
+    path = path or os.environ.get(
+        "FDT_COMPILATION_CACHE", os.path.expanduser("~/.cache/fdt_xla"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+
 def setup_platform(cfg: TrainConfig) -> None:
     """Select the JAX platform before first backend use.  `auto` keeps
     whatever the environment provides (TPU when available).  On cpu, a
@@ -32,6 +47,8 @@ def setup_platform(cfg: TrainConfig) -> None:
     import numpy as np
 
     import jax
+
+    enable_compilation_cache()
 
     if cfg.device != "auto":
         want = "tpu" if cfg.device == "tpu" else "cpu"
@@ -150,7 +167,7 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                      remat=cfg.remat)
 
 
-def make_loaders(cfg: TrainConfig, train_ds, eval_ds
+def make_loaders(cfg: TrainConfig, train_ds, eval_ds, dp: int = 1
                  ) -> Tuple[Callable, Callable, int]:
     """(train_loader(epoch), eval_loader(epoch), steps_per_epoch).
 
@@ -175,9 +192,22 @@ def make_loaders(cfg: TrainConfig, train_ds, eval_ds
                         shuffle=True, max_len=cfg.seq_len),
             depth=cfg.prefetch_depth)
 
+    # drop_last + a small (e.g. subset-strided) eval split can starve eval
+    # entirely; clamp so at least one eval batch always exists, keeping the
+    # global eval batch divisible by the data-parallel world size
+    n_eval = (len(eval_ds) if hasattr(eval_ds, "encode_batch")
+              else len(eval_ds[0]))
+    per_shard = max(dp // pc, 1)     # device shards fed from this host
+    eval_bs = min(local_bs, n_eval // pc)
+    eval_bs -= eval_bs % per_shard   # global eval batch must divide dp
+    if eval_bs == 0:
+        print(f"[warn] eval split ({n_eval} samples) smaller than the "
+              f"data-parallel world ({dp}); eval will see no batches")
+        eval_bs = per_shard
+
     def eval_loader(epoch: int):
         return PrefetchIterator(
-            BatchLoader(eval_ds, local_bs, epoch=0, seed=cfg.seed,
+            BatchLoader(eval_ds, eval_bs, epoch=0, seed=cfg.seed,
                         shuffle=False, max_len=cfg.seq_len),
             depth=cfg.prefetch_depth)
 
@@ -217,7 +247,7 @@ def run_training(cfg: TrainConfig,
     model = build_model(cfg, vocab_size=vocab, mesh=mesh)
 
     train_loader, eval_loader, steps_per_epoch = make_loaders(
-        cfg, train_ds, eval_ds)
+        cfg, train_ds, eval_ds, dp=dp_size(mesh))
 
     # xN LR scaling: actual DP world size, not the reference's hard-coded
     # x4 (resnet50_test.py:482-483).

@@ -59,6 +59,9 @@ class TrainConfig:
     fsdp: bool = False                # shard params/opt state over the dp axis
     host_offload: bool = False        # FSDP param offload to host memory
     remat: bool = False               # jax.checkpoint the model blocks
+    donate: bool = True               # donate the train state into the step
+                                      # (in-place update; disable on backends
+                                      # with donated-buffer dealloc bugs)
 
     # -- data -------------------------------------------------------------
     data_dir: str = "./data"

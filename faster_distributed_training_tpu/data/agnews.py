@@ -47,13 +47,23 @@ whom why will with you your
 """.split())
 
 
-def clean_text(text: str) -> str:
-    """strip HTML + URLs + stopwords (transformer_test.py:73-79,95)."""
+def clean_text_py(text: str) -> str:
+    """Pure-Python reference cleaner (transformer_test.py:73-79,95)."""
     text = html.unescape(text)
     text = _TAG_RE.sub(" ", text)
     text = _URL_RE.sub(" ", text)
     words = _TOKEN_RE.findall(text.lower())
     return " ".join(w for w in words if w not in STOPWORDS)
+
+
+def clean_text(text: str) -> str:
+    """strip HTML + URLs + stopwords (transformer_test.py:73-79,95).
+    Entity unescaping runs in Python (html.unescape's full HTML5 table);
+    the regex-heavy remainder uses the native C++ core when available —
+    byte-equality with clean_text_py is enforced by tests/test_runtime.py."""
+    from faster_distributed_training_tpu.runtime import native_lib
+    out = native_lib.clean_text(html.unescape(text))
+    return out if out is not None else clean_text_py(text)
 
 
 class HashTokenizer:
@@ -133,6 +143,21 @@ class AGNewsDataset:
         texts = [self.samples[i][0] for i in indices]
         labels = np.asarray([self.samples[i][1] for i in indices], np.int32)
         if isinstance(self.tokenizer, HashTokenizer):
+            from faster_distributed_training_tpu.runtime import native_lib
+            tk = self.tokenizer
+            native = native_lib.encode_batch(
+                texts, max_len, tk.vocab_size, tk.pad_id, tk.cls_id,
+                tk.sep_id, tk._reserved)
+            if native is not None:
+                tokens_full, lens = native
+                L = bucket_length(int(lens.max()),
+                                  [b for b in self.buckets if b <= max_len]
+                                  or [max_len])
+                tokens = tokens_full[:, :L]
+                mask = (np.arange(L)[None, :] < lens[:, None]).astype(np.int32)
+                return {"tokens": tokens,
+                        "token_types": np.zeros_like(tokens),
+                        "mask": mask, "label": labels}
             encoded = [self.tokenizer.encode(t, max_len) for t in texts]
             pad_id = self.tokenizer.pad_id
         else:

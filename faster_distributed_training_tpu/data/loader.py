@@ -72,7 +72,12 @@ class BatchLoader:
                 yield self.data.encode_batch(batch_idx, self.max_len)
             else:
                 x, y = self.data
-                yield {"image": x[batch_idx], "label": y[batch_idx]}
+                from faster_distributed_training_tpu.runtime import native_lib
+                xb = (native_lib.gather_u8(x, batch_idx)
+                      if isinstance(x, np.ndarray) and x.dtype == np.uint8
+                      else None)
+                yield {"image": xb if xb is not None else x[batch_idx],
+                       "label": y[batch_idx]}
 
 
 class PrefetchIterator:

@@ -1,0 +1,11 @@
+"""Native runtime layer: C++ host-data-path core with ctypes bindings.
+
+The reference's runtime-adjacent native surface is NCCL/cuDNN/Apex plus
+the Python-level collate hot spot (SURVEY.md §2); compute-side native
+code here is XLA/Pallas, and this package covers the HOST side: text
+cleaning/tokenization and batch gather in C++ (runtime/native/), built
+on demand and always backed by pure-Python fallbacks."""
+
+from faster_distributed_training_tpu.runtime import native_lib  # noqa: F401
+from faster_distributed_training_tpu.runtime.native_lib import (  # noqa: F401
+    available, clean_text, crc32, encode_batch, gather_u8)

@@ -1,0 +1,221 @@
+// fdt_native — native runtime core for the host data path.
+//
+// TPU-native counterpart of the reference's per-batch host work, which is
+// its documented CPU hot spot (transformer_test.py:93-104: HTML/URL strip +
+// stopword removal + tokenization inside the DataLoader collate; SURVEY.md
+// §3.3).  The Python implementations in data/agnews.py remain the semantic
+// reference; this library must produce byte-identical results (enforced by
+// tests/test_runtime.py) and is loaded opportunistically via ctypes
+// (runtime/native_lib.py) with graceful Python fallback.
+//
+// Exposed C ABI:
+//   fdt_clean_text     — tag/url strip + lowercase + [a-z0-9']+
+//                        tokenization + stopword filter over already
+//                        html-unescaped text (== data/agnews.py
+//                        clean_text after html.unescape)
+//   fdt_encode_batch   — cleaned text -> CLS + crc32-bucket ids + SEP,
+//                        padded to max_len (== HashTokenizer.encode)
+//   fdt_gather_u8      — index-gather of uint8 rows into a contiguous
+//                        batch buffer (the BatchLoader image collate)
+//   fdt_crc32          — zlib-compatible CRC32 (dataset integrity checks)
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- crc32
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t crc32_of(const uint8_t* data, size_t len) {
+  crc_init();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; ++i)
+    c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ------------------------------------------------------------- stopwords
+// Must equal data/agnews.py STOPWORDS.
+const char* kStopwords[] = {
+    "a", "about", "above", "after", "again", "against", "all", "am", "an",
+    "and", "any", "are", "as", "at", "be", "because", "been", "before",
+    "being", "below", "between", "both", "but", "by", "can", "did", "do",
+    "does", "doing", "down", "during", "each", "few", "for", "from",
+    "further", "had", "has", "have", "having", "he", "her", "here", "hers",
+    "him", "his", "how", "i", "if", "in", "into", "is", "it", "its", "just",
+    "me", "more", "most", "my", "no", "nor", "not", "now", "of", "off", "on",
+    "once", "only", "or", "other", "our", "out", "over", "own", "s", "same",
+    "she", "should", "so", "some", "such", "t", "than", "that", "the",
+    "their", "them", "then", "there", "these", "they", "this", "those",
+    "through", "to", "too", "under", "until", "up", "very", "was", "we",
+    "were", "what", "when", "where", "which", "while", "who", "whom", "why",
+    "will", "with", "you", "your"};
+
+const std::unordered_set<std::string>& stopword_set() {
+  static const std::unordered_set<std::string> set(
+      std::begin(kStopwords), std::end(kStopwords));
+  return set;
+}
+
+// -------------------------------------------------- tag / url stripping
+// HTML entity unescaping stays on the Python side (html.unescape's full
+// HTML5 table cannot be reproduced partially without diverging) — this
+// library receives ALREADY-UNESCAPED text (data/agnews.py clean_text).
+std::string strip_tags(const std::string& in) {   // <[^>]+> -> ' '
+  std::string out;
+  out.reserve(in.size());
+  size_t i = 0;
+  while (i < in.size()) {
+    if (in[i] == '<') {
+      size_t close = in.find('>', i + 1);
+      if (close != std::string::npos && close > i + 1) {
+        out += ' ';
+        i = close + 1;
+        continue;
+      }
+    }
+    out += in[i++];
+  }
+  return out;
+}
+
+bool starts_with(const std::string& s, size_t i, const char* pre) {
+  size_t n = std::strlen(pre);
+  return s.compare(i, n, pre) == 0;
+}
+
+bool is_space(char c) {
+  // must match Python's \s for ASCII: [ \t\n\r\f\v]
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f'
+         || c == '\v';
+}
+
+std::string strip_urls(const std::string& in) {
+  // https?://\S+ | www\.\S+  (case-sensitive, pre-lowercase — matching
+  // the Python regex exactly, data/agnews.py:33).  The \S+ requires at
+  // least ONE non-space character after the prefix: a bare "http:// "
+  // or trailing "www." does NOT match (and so survives into tokens).
+  std::string out;
+  out.reserve(in.size());
+  size_t i = 0;
+  while (i < in.size()) {
+    size_t pre = 0;
+    if (starts_with(in, i, "https://")) pre = 8;
+    else if (starts_with(in, i, "http://")) pre = 7;
+    else if (starts_with(in, i, "www.")) pre = 4;
+    if (pre && i + pre < in.size() && !is_space(in[i + pre])) {
+      out += ' ';
+      i += pre;
+      while (i < in.size() && !is_space(in[i])) ++i;
+      continue;
+    }
+    out += in[i++];
+  }
+  return out;
+}
+
+bool is_token_char(char c) {
+  return (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9') || c == '\'';
+}
+
+std::string clean_impl(const std::string& raw) {
+  std::string text = strip_urls(strip_tags(raw));
+  // lowercase (ASCII; non-ASCII bytes never match the token class)
+  for (auto& c : text)
+    if (c >= 'A' && c <= 'Z') c += 32;
+  const auto& stop = stopword_set();
+  std::string out, word;
+  for (size_t i = 0; i <= text.size(); ++i) {
+    char c = i < text.size() ? text[i] : ' ';
+    if (is_token_char(c)) {
+      word += c;
+    } else if (!word.empty()) {
+      if (!stop.count(word)) {
+        if (!out.empty()) out += ' ';
+        out += word;
+      }
+      word.clear();
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t fdt_crc32(const uint8_t* data, int64_t len) {
+  return crc32_of(data, static_cast<size_t>(len));
+}
+
+// Clean `in` into `out` (NUL-terminated).  Returns the cleaned length, or
+// -(needed+1) if out_cap is too small.
+int64_t fdt_clean_text(const char* in, char* out, int64_t out_cap) {
+  std::string cleaned = clean_impl(in);
+  int64_t need = static_cast<int64_t>(cleaned.size());
+  if (need + 1 > out_cap) return -(need + 1);
+  std::memcpy(out, cleaned.data(), cleaned.size());
+  out[need] = '\0';
+  return need;
+}
+
+// HashTokenizer.encode over a batch of ALREADY-CLEANED texts:
+// ids = [CLS] + [crc32(word) % (vocab-999) + 999, ...][:max_len-2] + [SEP],
+// right-padded with pad_id to max_len.  out_tokens: [n, max_len] int32,
+// out_lens: [n] int32 (unpadded length incl. CLS/SEP).
+int32_t fdt_encode_batch(const char** texts, int32_t n, int32_t max_len,
+                         int32_t vocab_size, int32_t pad_id, int32_t cls_id,
+                         int32_t sep_id, int32_t reserved,
+                         int32_t* out_tokens, int32_t* out_lens) {
+  if (max_len < 2 || vocab_size <= reserved) return -1;
+  for (int32_t b = 0; b < n; ++b) {
+    int32_t* row = out_tokens + static_cast<int64_t>(b) * max_len;
+    int32_t pos = 0;
+    row[pos++] = cls_id;
+    const char* t = texts[b];
+    size_t i = 0, len = std::strlen(t);
+    while (i < len && pos < max_len - 1) {
+      while (i < len && t[i] == ' ') ++i;
+      size_t start = i;
+      while (i < len && t[i] != ' ') ++i;
+      if (i > start) {
+        uint32_t h = crc32_of(reinterpret_cast<const uint8_t*>(t + start),
+                              i - start) %
+                     static_cast<uint32_t>(vocab_size - reserved);
+        row[pos++] = static_cast<int32_t>(h) + reserved;
+      }
+    }
+    row[pos++] = sep_id;
+    out_lens[b] = pos;
+    for (; pos < max_len; ++pos) row[pos] = pad_id;
+  }
+  return 0;
+}
+
+// Gather `n` rows of `row_bytes` each from `src` at `indices` into `dst`
+// (the image-batch collate: dst[i] = src[indices[i]]).
+int32_t fdt_gather_u8(const uint8_t* src, const int64_t* indices, int32_t n,
+                      int64_t row_bytes, uint8_t* dst) {
+  for (int32_t i = 0; i < n; ++i)
+    std::memcpy(dst + static_cast<int64_t>(i) * row_bytes,
+                src + indices[i] * row_bytes, row_bytes);
+  return 0;
+}
+
+}  // extern "C"

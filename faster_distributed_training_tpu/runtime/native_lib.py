@@ -1,0 +1,142 @@
+"""Build + bind the native runtime core (runtime/native/fdt_native.cc).
+
+The library is compiled on demand with g++ (cached by source mtime) and
+bound through ctypes — no pybind11 dependency in this environment.  Every
+entry point has a pure-Python fallback in data/, so the framework works
+even without a toolchain; when the library IS available the data path
+uses it (see data/agnews.py / data/loader.py call sites).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native", "fdt_native.cc")
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_LIB = os.path.join(_BUILD_DIR, "libfdt_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The bound library, building it if stale/absent; None on failure."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            stale = (not os.path.exists(_LIB)
+                     or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+            if stale and not _build():
+                _load_failed = True
+                return None
+            lib = ctypes.CDLL(_LIB)
+            lib.fdt_crc32.restype = ctypes.c_uint32
+            lib.fdt_crc32.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+            lib.fdt_clean_text.restype = ctypes.c_int64
+            lib.fdt_clean_text.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                           ctypes.c_int64]
+            lib.fdt_encode_batch.restype = ctypes.c_int32
+            lib.fdt_encode_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+            lib.fdt_gather_u8.restype = ctypes.c_int32
+            lib.fdt_gather_u8.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int32, ctypes.c_int64, ctypes.c_char_p]
+            _lib = lib
+        except Exception:
+            _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def crc32(data: bytes) -> int:
+    lib = load()
+    if lib is None:
+        import zlib
+        return zlib.crc32(data)
+    return lib.fdt_crc32(data, len(data))
+
+
+def clean_text(text: str) -> Optional[str]:
+    """Native clean_text; None when the library is unavailable (caller
+    falls back to the Python implementation)."""
+    lib = load()
+    if lib is None:
+        return None
+    raw = text.encode("utf-8", "ignore")
+    cap = max(len(raw) + 16, 64)
+    buf = ctypes.create_string_buffer(cap)
+    n = lib.fdt_clean_text(raw, buf, cap)
+    if n < 0:                       # shouldn't happen: cleaning only shrinks
+        cap = -int(n)
+        buf = ctypes.create_string_buffer(cap)
+        n = lib.fdt_clean_text(raw, buf, cap)
+        if n < 0:
+            return None
+    return buf.raw[:n].decode("utf-8", "ignore")
+
+
+def encode_batch(texts: List[str], max_len: int, vocab_size: int,
+                 pad_id: int = 0, cls_id: int = 101, sep_id: int = 102,
+                 reserved: int = 999
+                 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native HashTokenizer batch encode of CLEANED texts.
+    Returns (tokens [n, max_len] int32, lens [n] int32) or None."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(texts)
+    tokens = np.empty((n, max_len), np.int32)
+    lens = np.empty((n,), np.int32)
+    arr = (ctypes.c_char_p * n)(*[t.encode("utf-8", "ignore") for t in texts])
+    rc = lib.fdt_encode_batch(
+        arr, n, max_len, vocab_size, pad_id, cls_id, sep_id, reserved,
+        tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc != 0:
+        return None
+    return tokens, lens
+
+
+def gather_u8(src: np.ndarray, indices: np.ndarray) -> Optional[np.ndarray]:
+    """dst[i] = src[indices[i]] for a C-contiguous uint8 array; None when
+    the library is unavailable."""
+    lib = load()
+    if lib is None or src.dtype != np.uint8 or not src.flags.c_contiguous:
+        return None
+    idx = np.ascontiguousarray(indices, np.int64)
+    row_bytes = int(np.prod(src.shape[1:])) * src.itemsize
+    dst = np.empty((len(idx),) + src.shape[1:], np.uint8)
+    lib.fdt_gather_u8(
+        src.ctypes.data_as(ctypes.c_char_p),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(idx), row_bytes, dst.ctypes.data_as(ctypes.c_char_p))
+    return dst

@@ -41,7 +41,8 @@ class Trainer:
         # defaults to the train staging function
         self.put_eval_batch = put_eval_batch or self.put_batch
         self.log = log if jax.process_index() == 0 else (lambda *_: None)
-        self.train_step = jax.jit(make_train_step(cfg), donate_argnums=0)
+        donate = {"donate_argnums": 0} if getattr(cfg, "donate", True) else {}
+        self.train_step = jax.jit(make_train_step(cfg), **donate)
         self.eval_step = jax.jit(make_eval_step(cfg))
         self.history: Dict[str, List[float]] = {
             "train_acc": [], "test_acc": [], "train_loss": [],

@@ -26,12 +26,17 @@ import jax
 import jax.numpy as jnp
 
 
-def sample_lam(key: jax.Array, alpha: float) -> jax.Array:
+def sample_lam(key: jax.Array, alpha) -> jax.Array:
     """lambda ~ Beta(alpha, alpha) when alpha > 0, else the constant alpha
-    (resnet50_test.py:357-361)."""
-    if alpha > 0:
-        return jax.random.beta(key, alpha, alpha)
-    return jnp.asarray(alpha, jnp.float32)
+    (resnet50_test.py:357-361).  Accepts a traced alpha (the vmap-over-
+    trials sweep, tuning/vmap_sweep.py, maps over it)."""
+    if isinstance(alpha, (int, float)):
+        if alpha > 0:
+            return jax.random.beta(key, alpha, alpha)
+        return jnp.asarray(alpha, jnp.float32)
+    alpha = jnp.asarray(alpha, jnp.float32)
+    safe = jnp.maximum(alpha, 1e-6)
+    return jnp.where(alpha > 0, jax.random.beta(key, safe, safe), alpha)
 
 
 def mixup_data(key: jax.Array, x: jax.Array, y: jax.Array, alpha: float = 0.99,

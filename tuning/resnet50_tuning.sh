@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# ResNet grid: mixup alpha x LR-decay gamma — the reference sweep
+# (tuning/resnet50_tuning.sh:1-11: 3 alphas x 3 gammas, NGD, 5 epochs,
+# 1/10 subset) as one aggregated run.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python tuning/sweep.py resnet --ngd \
+  --grid alpha=0.2,0.4,0.6 gamma=0.1,0.2,0.3 \
+  --out tuning/resnet_results.json "$@"

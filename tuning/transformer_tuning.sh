@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Transformer grid: lr x weight decay — the reference sweep
+# (tuning/transformer_tuning.sh:1-11: 3 lrs x 3 weight decays, 5 epochs,
+# 1/10 subset; note its line 8 echoes a misspelled --weighted_decay flag,
+# fixed here) as one aggregated run.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python tuning/sweep.py transformer --ngd \
+  --grid lr=1e-5,5e-5,1e-4 weight_decay=1e-4,1e-3,1e-2 \
+  --out tuning/transformer_results.json "$@"

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""vmap-over-trials hyperparameter sweep — one compiled program trains K
+configurations simultaneously.
+
+The reference runs its grid as K sequential full processes
+(tuning/resnet50_tuning.sh bash loop).  On TPU, small-model trials leave
+the chip mostly idle; vmapping the train step over a trial axis turns the
+sweep into one big batched program (K× the matmul batch — MXU-friendly),
+and sharding the trial axis over the `dp` mesh axis spreads trials across
+chips/hosts (BASELINE.json config 5).
+
+Per-trial hyperparameters:
+  * lr     — via optax.inject_hyperparams, so the learning rate lives in
+             the (vmapped) optimizer state instead of a baked schedule;
+  * alpha  — mixup Beta parameter, traced into jax.random.beta;
+  * seed   — independent PRNG stream per trial.
+
+Supported optimizers here: sgd | madgrad | mirror_madgrad (factories whose
+learning_rate argument inject_hyperparams can lift).  The NGD grid runs
+through tuning/sweep.py instead (its Fisher state depends on a baked
+update schedule).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, Iterable, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from faster_distributed_training_tpu.config import TrainConfig
+from faster_distributed_training_tpu.models import get_model
+from faster_distributed_training_tpu.optim.madgrad import (madgrad,
+                                                           mirror_madgrad)
+from faster_distributed_training_tpu.train import mixup_data, mixup_criterion
+from faster_distributed_training_tpu.train.losses import cross_entropy
+
+_FACTORIES = {
+    "sgd": lambda lr: optax.sgd(lr, momentum=0.9),
+    "madgrad": lambda lr: madgrad(lr),
+    "mirror_madgrad": lambda lr: mirror_madgrad(lr),
+}
+
+
+def _make_tx(optimizer: str) -> optax.GradientTransformation:
+    factory = _FACTORIES[optimizer]
+    return optax.inject_hyperparams(
+        lambda learning_rate: factory(learning_rate))(learning_rate=0.0)
+
+
+def vmap_trials(cfg: TrainConfig,
+                lrs: Iterable[float],
+                alphas: Iterable[float],
+                data: Tuple[np.ndarray, np.ndarray],
+                optimizer: str = "sgd",
+                steps: Optional[int] = None,
+                mesh=None) -> Dict[str, np.ndarray]:
+    """Train K=len(lrs) trials in one vmapped program; returns per-trial
+    final loss / train accuracy arrays.
+
+    lrs/alphas must have equal length K.  `data` is an in-memory (images
+    NHWC float, labels) tuple; every trial sees the same batch stream
+    (common random numbers — variance reduction for the grid comparison).
+    With `mesh`, trial-axis leaves are sharded over the `dp` axis.
+    """
+    lrs = jnp.asarray(list(lrs), jnp.float32)
+    alphas = jnp.asarray(list(alphas), jnp.float32)
+    K = lrs.shape[0]
+    assert alphas.shape[0] == K, "lrs and alphas must have equal length"
+
+    model = get_model(cfg.model, cfg.num_classes)
+    tx = _make_tx(optimizer)
+    x_all, y_all = data
+    x_all = jnp.asarray(x_all, jnp.float32)
+    y_all = jnp.asarray(y_all, jnp.int32)
+    n = x_all.shape[0]
+    bs = min(cfg.batch_size, n)
+    steps = steps or max(n // bs, 1) * cfg.epochs
+
+    def init_trial(seed, lr):
+        variables = model.init({"params": seed}, x_all[:1], train=False)
+        opt_state = tx.init(variables["params"])
+        opt_state = opt_state._replace(hyperparams={"learning_rate": lr})
+        return (variables["params"], variables.get("batch_stats", {}),
+                opt_state)
+
+    def trial_step(carry, inputs, alpha):
+        params, stats, opt_state, rng = carry
+        xb, yb = inputs
+        rng, k_mix, k_drop = jax.random.split(rng, 3)
+
+        def loss_fn(p):
+            xm, y_a, y_b, lam = mixup_data(k_mix, xb, yb, alpha)
+            out, mutated = model.apply(
+                {"params": p, "batch_stats": stats}, xm, train=True,
+                rngs={"dropout": k_drop}, mutable=["batch_stats"])
+            loss = mixup_criterion(cross_entropy, out, y_a, y_b, lam)
+            return loss, (mutated.get("batch_stats", stats), out, y_a)
+
+        (loss, (stats, out, y_a)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        acc = jnp.mean(jnp.argmax(out, -1) == y_a)
+        return (params, stats, opt_state, rng), (loss, acc)
+
+    seeds = jax.vmap(lambda i: jax.random.fold_in(
+        jax.random.PRNGKey(cfg.seed), i))(jnp.arange(K))
+
+    @jax.jit
+    def run(seeds, lrs, alphas):
+        states = jax.vmap(init_trial)(seeds, lrs)
+        rngs = jax.vmap(lambda s: jax.random.fold_in(s, 7))(seeds)
+
+        def scan_body(carry, step_idx):
+            params, stats, opt_state, rngs = carry
+            start = (step_idx * bs) % max(n - bs + 1, 1)
+            xb = jax.lax.dynamic_slice_in_dim(x_all, start, bs)
+            yb = jax.lax.dynamic_slice_in_dim(y_all, start, bs)
+            (params, stats, opt_state, rngs), (loss, acc) = jax.vmap(
+                trial_step, in_axes=(0, None, 0)
+            )((params, stats, opt_state, rngs), (xb, yb), alphas)
+            return (params, stats, opt_state, rngs), (loss, acc)
+
+        carry = (states[0], states[1], states[2], rngs)
+        carry, (losses, accs) = jax.lax.scan(
+            scan_body, carry, jnp.arange(steps))
+        return losses, accs
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        trial_sharding = NamedSharding(mesh, P("dp"))
+        seeds, lrs, alphas = (jax.device_put(a, trial_sharding)
+                              for a in (seeds, lrs, alphas))
+    losses, accs = run(seeds, lrs, alphas)
+    return {"final_loss": np.asarray(losses[-1]),
+            "final_acc": np.asarray(accs[-1]),
+            "loss_curve": np.asarray(losses),
+            "acc_curve": np.asarray(accs)}
+
+
+def main(argv=None):
+    import argparse
+
+    from faster_distributed_training_tpu.data import synthetic_cifar
+    from faster_distributed_training_tpu.parallel import make_mesh
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="resnet18")
+    p.add_argument("--optimizer", default="sgd",
+                   choices=sorted(_FACTORIES))
+    p.add_argument("--lrs", default="0.01,0.05,0.1,0.2")
+    p.add_argument("--alphas", default="0.2,0.2,0.2,0.2")
+    p.add_argument("--bs", type=int, default=64)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--device", default="auto")
+    p.add_argument("--mesh_trials", action="store_true",
+                   help="shard the trial axis over a dp mesh")
+    args = p.parse_args(argv)
+
+    cfg = TrainConfig(model=args.model, batch_size=args.bs, device=args.device)
+    from faster_distributed_training_tpu.cli import setup_platform
+    setup_platform(cfg)
+    lrs = [float(v) for v in args.lrs.split(",")]
+    alphas = [float(v) for v in args.alphas.split(",")]
+    data = synthetic_cifar(n=1024)
+    mesh = make_mesh(("dp",)) if args.mesh_trials else None
+    out = vmap_trials(cfg, lrs, alphas, data, optimizer=args.optimizer,
+                      steps=args.steps, mesh=mesh)
+    print(f"{'lr':>8} {'alpha':>6} {'loss':>8} {'acc':>6}")
+    for lr, a, l, acc in zip(lrs, alphas, out["final_loss"],
+                             out["final_acc"]):
+        print(f"{lr:>8.4g} {a:>6.2f} {l:>8.4f} {acc:>6.3f}")
+
+
+if __name__ == "__main__":
+    main()
