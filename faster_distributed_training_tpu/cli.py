@@ -152,14 +152,24 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
 
     from faster_distributed_training_tpu.models import get_model
 
+    import jax
+
     dtype = jnp.bfloat16 if cfg.precision == "bf16" else jnp.float32
     if cfg.model == "transformer":
         impl = resolve_attention(cfg, mesh)
+        mlp_impl = cfg.mlp_impl or (
+            "pallas" if jax.default_backend() == "tpu" else "fused")
+        if mlp_impl == "pallas" and jax.default_backend() != "tpu":
+            import warnings
+            warnings.warn(
+                "--mlp_impl pallas off-TPU runs the kernel in Pallas "
+                "INTERPRET mode (orders of magnitude slower) — test-only; "
+                "use --mlp_impl fused for real off-TPU runs", stacklevel=2)
         return get_model("transformer", cfg.num_classes,
                          vocab=vocab_size or 30522, maxlen=cfg.seq_len,
                          n_layers=cfg.n_layers, d_model=cfg.d_model,
                          d_ff=cfg.d_ff, h=cfg.n_heads,
-                         attention_impl=impl,
+                         attention_impl=impl, mlp_impl=mlp_impl,
                          mesh=mesh if impl == "ring" else None,
                          alpha=cfg.alpha if cfg.alpha > 0 else 0.99,
                          dtype=dtype, remat=cfg.remat)

@@ -57,6 +57,9 @@ class TrainConfig:
     mesh_shape: Tuple[int, ...] = ()  # () = auto: all devices on the dp axis
     mesh_axes: Tuple[str, ...] = ("dp",)
     fsdp: bool = False                # shard params/opt state over the dp axis
+    zero1: bool = False               # shard ONLY optimizer state over the
+                                      # data axes (ZeroRedundancyOptimizer
+                                      # analog, transformer_test.py:4,221-222)
     host_offload: bool = False        # FSDP param offload to host memory
     remat: bool = False               # jax.checkpoint the model blocks
     donate: bool = True               # donate the train state into the step
@@ -76,6 +79,7 @@ class TrainConfig:
     d_ff: int = 1024
     n_heads: int = 8
     attention: str = ""               # "" auto | dense | flash | ring
+    mlp_impl: str = ""                # "" auto (pallas on TPU) | fused | pallas
 
     # -- bookkeeping ------------------------------------------------------
     seed: int = 123456                # resnet50_test.py:728
@@ -117,6 +121,9 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--mesh", default="", type=str,
                    help="mesh as axis=size pairs, e.g. 'dp=4,fsdp=2' (default: all dp)")
     p.add_argument("--fsdp", action="store_true", help="fully-shard params/opt state")
+    p.add_argument("--zero1", action="store_true",
+                   help="shard only optimizer state over the data axes "
+                        "(ZeRO-1; params stay replicated)")
     p.add_argument("--host_offload", action="store_true")
     p.add_argument("--remat", action="store_true")
     p.add_argument("--data_dir", default=d.data_dir, type=str)
@@ -137,6 +144,10 @@ def build_parser(prog: str = "fdt",
                    choices=["", "dense", "flash", "ring"],
                    help="attention impl ('' = ring when the mesh has an sp "
                         "axis, flash on TPU, else dense)")
+    p.add_argument("--mlp_impl", default=d.mlp_impl,
+                   choices=["", "fused", "pallas"],
+                   help="classifier MLP kernel ('' = pallas on TPU, else "
+                        "the custom_vjp fused path)")
     return p
 
 
@@ -165,12 +176,14 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         distributed=args.distributed, use_ngd=args.ngd,
         weight_decay=args.weight_decay, gamma=args.gamma,
         optimizer=args.optimizer, device=args.device, precision=args.precision,
-        fsdp=args.fsdp, host_offload=args.host_offload, remat=args.remat,
+        fsdp=args.fsdp, zero1=args.zero1, host_offload=args.host_offload,
+        remat=args.remat,
         data_dir=args.data_dir, subset_stride=args.subset_stride, seed=args.seed,
         checkpoint_dir=args.checkpoint_dir, profile=args.profile,
         plot=not args.no_plot,
         seq_len=args.seq_len, n_layers=args.n_layers, d_model=args.d_model,
         d_ff=args.d_ff, n_heads=args.n_heads, attention=args.attention,
+        mlp_impl=args.mlp_impl,
     )
     if args.model:
         cfg = cfg.replace(model=args.model)

@@ -16,10 +16,17 @@ import numpy as np
 def synthetic_cifar(n: int = 1024, seed: int = 0, num_classes: int = 10
                     ) -> Tuple[np.ndarray, np.ndarray]:
     """(NHWC uint8 images, int32 labels) with learnable class structure:
-    class k images are noise biased by a per-class mean pattern."""
+    class k images are noise biased by a per-class mean pattern.
+
+    The prototypes come from a FIXED rng, independent of `seed` — `seed`
+    only varies labels/noise.  Different splits (train seed 0, test seed
+    1) therefore share the class structure, so generalization is
+    measurable; deriving prototypes from `seed` would give every split
+    its own classes and pin test accuracy at chance."""
     rng = np.random.default_rng(seed)
+    proto_rng = np.random.default_rng(20260101)
     labels = rng.integers(0, num_classes, size=n).astype(np.int32)
-    prototypes = rng.integers(0, 256, size=(num_classes, 32, 32, 3))
+    prototypes = proto_rng.integers(0, 256, size=(num_classes, 32, 32, 3))
     noise = rng.normal(0, 40, size=(n, 32, 32, 3))
     x = np.clip(prototypes[labels] * 0.6 + noise + 50, 0, 255).astype(np.uint8)
     return x, labels
@@ -36,10 +43,15 @@ def synthetic_agnews(n: int = 512, seed: int = 0, vocab: int = 30522,
         def __init__(self):
             self._labels = rng.integers(0, num_classes, n).astype(np.int32)
             self._lens = rng.integers(8, max_len, n)
-            # class-dependent token distribution so it is learnable
+            # class-dependent token distribution, consistent across
+            # splits: every token is congruent to the label modulo
+            # num_classes (uniform noise + a shared constant would stay
+            # uniform — not learnable)
             self._tokens = [
-                (rng.integers(1000, vocab, size=ln)
-                 + self._labels[i]) % vocab for i, ln in enumerate(self._lens)]
+                1000 + (rng.integers(0, (vocab - 1000) // num_classes,
+                                     size=ln) * num_classes
+                        + self._labels[i])
+                for i, ln in enumerate(self._lens)]
 
         def __len__(self):
             return n

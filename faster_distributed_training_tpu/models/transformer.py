@@ -34,7 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from faster_distributed_training_tpu.ops.fused_mlp import fused_mlp
+from faster_distributed_training_tpu.ops.fused_mlp import (fused_mlp,
+                                                           fused_mlp_pallas)
 
 Dtype = Any
 NEG_INF = -1e9  # proper masking constant (reference bug: -1e-9)
@@ -201,6 +202,7 @@ class Transformer(nn.Module):
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
     attention_impl: str = "dense"  # dense | flash | ring
+    mlp_impl: str = "fused"        # fused (custom_vjp) | pallas
     mesh: Optional[Any] = None     # required for attention_impl='ring'
     sp_axis: str = "sp"
     remat: bool = False
@@ -264,10 +266,12 @@ class Transformer(nn.Module):
         b2 = self.param("cls_b2", nn.initializers.zeros,
                         (1, self.n_class), self.param_dtype)
 
+        mlp_fn = fused_mlp_pallas if self.mlp_impl == "pallas" else fused_mlp
+
         def classify(z):
-            logits = fused_mlp(z.astype(self.dtype), w1.astype(self.dtype),
-                               b1.astype(self.dtype), w2.astype(self.dtype),
-                               b2.astype(self.dtype))
+            logits = mlp_fn(z.astype(self.dtype), w1.astype(self.dtype),
+                            b1.astype(self.dtype), w2.astype(self.dtype),
+                            b2.astype(self.dtype))
             return logits.astype(jnp.float32)
 
         if not train:

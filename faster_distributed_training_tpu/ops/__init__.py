@@ -10,7 +10,7 @@ kernels for the attention hot path.
 from faster_distributed_training_tpu.ops.conv_bn import (  # noqa: F401
     conv2d, conv_bn_train, fused_conv_bn, conv_bn_reference)
 from faster_distributed_training_tpu.ops.fused_mlp import (  # noqa: F401
-    fused_mlp, mlp_reference)
+    fused_mlp, fused_mlp_pallas, mlp_reference)
 from faster_distributed_training_tpu.ops.attention import (  # noqa: F401
     blockwise_attention, dense_attention_reference)
 from faster_distributed_training_tpu.ops.flash_attention import (  # noqa: F401

@@ -14,6 +14,15 @@ Semantics matched to the reference:
   * eps is added to the *standard deviation*, not the variance
     (``denom = sqrt_var + eps``, ``resnet.py:94``), default 1e-3.
 
+Why there is no Pallas kernel here (a deliberate decision, unlike
+``ops/flash_attention.py`` / ``fused_mlp_pallas``): the convolution is a
+single XLA HLO that the TPU conv emitter tiles onto the MXU, and the BN
+normalize is an elementwise chain XLA fuses into that conv's epilogue —
+there is no leftover fusion for a hand-written kernel to claim, only the
+risk of losing the emitter's layout/pipelining.  The fused-kernel value
+on this path is the *backward recompute policy* below, which is a
+differentiation-level decision, not a kernel-level one.
+
 Differences (deliberate, documented per SURVEY.md §7 "bugs to fix"):
   * layout is NHWC / HWIO (TPU-native) instead of NCHW / OIHW;
   * any stride is supported (reference asserts stride == 1,

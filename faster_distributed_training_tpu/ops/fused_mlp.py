@@ -84,3 +84,76 @@ def _mlp_bwd(mean_bias_grad, res, g):
 
 
 fused_mlp.defvjp(_mlp_fwd, _mlp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel — both matmuls + ReLU in one VMEM-resident pass
+# ---------------------------------------------------------------------------
+
+def _mlp_kernel(x_ref, w1t_ref, b1_ref, w2t_ref, b2_ref, o_ref):
+    """One row-block: h = x@w1ᵀ+b1; out = relu(h)@w2ᵀ+b2.
+
+    The hidden activations live only in VMEM/registers — they are never
+    written to HBM, which is the point of fusing (the reference instead
+    *saves* them for backward, transformer.py:301)."""
+    x = x_ref[...]
+    h = jax.lax.dot(x, w1t_ref[...],
+                    preferred_element_type=jnp.float32) + b1_ref[...]
+    a = jnp.maximum(h, 0.0).astype(x.dtype)
+    o = jax.lax.dot(a, w2t_ref[...],
+                    preferred_element_type=jnp.float32) + b2_ref[...]
+    o_ref[...] = o.astype(o_ref.dtype)
+
+
+def _mlp_fwd_pallas(x2d: jax.Array, w1: jax.Array, b1: jax.Array,
+                    w2: jax.Array, b2: jax.Array,
+                    block_b: int = 256) -> jax.Array:
+    """x2d [B, d_in]; weights (out, in) like torch.nn.Linear.  Weights are
+    passed transposed and fully VMEM-resident (d_model≤1k → ≤4 MiB of the
+    ~16 MiB budget); rows are tiled over the grid."""
+    from jax.experimental import pallas as pl
+
+    B, d_in = x2d.shape
+    d_h, d_out = w1.shape[0], w2.shape[0]
+    block_b = min(block_b, B)
+    nb = -(-B // block_b)
+    pad = nb * block_b - B
+    if pad:
+        x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        _mlp_kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((block_b, d_in), lambda i: (i, 0)),
+            pl.BlockSpec((d_in, d_h), lambda i: (0, 0)),
+            pl.BlockSpec((1, d_h), lambda i: (0, 0)),
+            pl.BlockSpec((d_h, d_out), lambda i: (0, 0)),
+            pl.BlockSpec((1, d_out), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_b, d_out), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb * block_b, d_out), x2d.dtype),
+        interpret=(jax.default_backend() != "tpu"),
+    )(x2d, w1.T, jnp.reshape(b1, (1, d_h)), w2.T, jnp.reshape(b2, (1, d_out)))
+    return out[:B] if pad else out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def fused_mlp_pallas(x: jax.Array, w1: jax.Array, b1: Optional[jax.Array],
+                     w2: jax.Array, b2: Optional[jax.Array],
+                     mean_bias_grad: bool = False) -> jax.Array:
+    """Pallas-kernel forward of the fused MLP; backward is the same
+    recompute-in-backward VJP as ``fused_mlp`` (plain MXU matmuls XLA
+    already schedules well).  Interpreter mode runs it on CPU for tests."""
+    zero1 = jnp.zeros((w1.shape[0],), x.dtype) if b1 is None else b1
+    zero2 = jnp.zeros((w2.shape[0],), x.dtype) if b2 is None else b2
+    lead = x.shape[:-1]
+    out = _mlp_fwd_pallas(x.reshape(-1, x.shape[-1]), w1, zero1, w2, zero2)
+    return out.reshape(*lead, w2.shape[0])
+
+
+def _mlp_fwd_pallas_vjp(x, w1, b1, w2, b2, mean_bias_grad):
+    return fused_mlp_pallas(x, w1, b1, w2, b2, mean_bias_grad), (
+        x, w1, b1, w2, b2)
+
+
+fused_mlp_pallas.defvjp(_mlp_fwd_pallas_vjp, _mlp_bwd)

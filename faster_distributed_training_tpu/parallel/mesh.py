@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import warnings
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -61,6 +62,13 @@ def make_mesh(axes: Sequence[str] = ("dp",),
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build a Mesh. Empty `shape` auto-sizes: one unsized axis absorbs all devices.
 
+    Single-process only: a shape smaller than the visible device count
+    uses the FIRST prod(shape) devices — the CUDA_VISIBLE_DEVICES-
+    narrowing analog (run_distributed.sh:2), e.g. `--mesh dp=1` on an
+    8-chip host.  Multi-host runs keep the exact-count requirement: a
+    mesh built from a subset would exclude some processes' addressable
+    devices and fail far later inside batch assembly.
+
     Examples:
       make_mesh()                          -> all devices on "dp"
       make_mesh(("dp","tp"), (2, 4))       -> 2x4 mesh
@@ -74,10 +82,18 @@ def make_mesh(axes: Sequence[str] = ("dp",),
     shape = tuple(shape)
     if len(shape) != len(axes):
         raise ValueError(f"mesh axes {axes} vs shape {shape} rank mismatch")
-    if int(np.prod(shape)) != n:
-        raise ValueError(f"mesh shape {shape} needs {int(np.prod(shape))} devices, "
-                         f"have {n}")
-    dev_array = np.asarray(devices).reshape(shape)
+    want = int(np.prod(shape))
+    if want > n or (want < n and jax.process_count() > 1):
+        raise ValueError(f"mesh shape {shape} needs {want} devices, "
+                         f"have {n}"
+                         + (" across all hosts — per-host narrowing is "
+                            "not supported in multi-process runs"
+                            if jax.process_count() > 1 else ""))
+    if want < n:
+        warnings.warn(f"mesh shape {shape} uses {want} of {n} visible "
+                      f"devices; the remaining {n - want} idle",
+                      stacklevel=2)
+    dev_array = np.asarray(devices[:want]).reshape(shape)
     return Mesh(dev_array, axes)
 
 

@@ -9,6 +9,9 @@ sharding decisions (SURVEY.md §2 checklist):
                the ``fsdp`` axis (ZeRO-3); XLA lowers the gradient psum
                to reduce_scatter + all_gather exactly like FSDP's
                C++ hooks (transformer_test.py:387-392).
+  * ZeRO-1   — params replicated, only optimizer state sharded over a
+               data axis (the commented ZeroRedundancyOptimizer wrap,
+               transformer_test.py:4,221-222).
   * offload  — params/opt state pinned to host memory
                (``memory_kind='pinned_host'``), the CPUOffload analog
                (transformer_test.py:46-48).
@@ -49,6 +52,18 @@ def train_state_shardings(state, mesh: Mesh, cfg: TrainConfig):
     size > 1 (TP wins over the FSDP spec on matched tensors)."""
     if cfg.fsdp and "fsdp" in mesh.axis_names:
         specs = fsdp_partition_params(state, mesh, axis="fsdp")
+    elif cfg.zero1:
+        # ZeRO-1 (ZeroRedundancyOptimizer analog, transformer_test.py:4,
+        # 221-222): params stay replicated, only the optimizer state —
+        # momentum buffers, Fisher factors, MADGRAD accumulators — is
+        # sharded over a data axis.  XLA inserts the gather at tx.update.
+        ax = next((a for a in ("fsdp", "dp") if a in mesh.axis_names
+                   and mesh.shape[a] > 1), None)
+        specs = jax.tree.map(lambda _: P(), state)
+        if ax is not None:
+            specs = specs.replace(
+                opt_state=fsdp_partition_params(state.opt_state, mesh,
+                                                axis=ax))
     else:
         specs = jax.tree.map(lambda _: P(), state)
     if "tp" in mesh.axis_names and mesh.shape["tp"] > 1:

@@ -141,6 +141,37 @@ class TestDownloadInfra:
         with pytest.raises(RuntimeError, match="synthetic"):
             dl.download_url("http://127.0.0.1:9/none.bin", str(tmp_path))
 
+    def test_read_pfm_roundtrip(self, tmp_path):
+        # grayscale + color, little-endian (negative scale), bottom-up rows
+        img = np.arange(12, dtype="<f4").reshape(3, 4)
+        p = tmp_path / "g.pfm"
+        with open(p, "wb") as f:
+            f.write(b"Pf\n4 3\n-1.0\n")
+            f.write(img[::-1].tobytes())  # PFM stores rows bottom-up
+        got = dl.read_pfm(str(p))
+        np.testing.assert_array_equal(got, img)
+        rgb = np.arange(24, dtype="<f4").reshape(2, 4, 3)
+        p2 = tmp_path / "c.pfm"
+        with open(p2, "wb") as f:
+            f.write(b"PF\n# comment\n4 2\n-1.0\n")
+            f.write(rgb[::-1].tobytes())
+        np.testing.assert_array_equal(dl.read_pfm(str(p2)), rgb)
+        bad = tmp_path / "bad.pfm"
+        bad.write_bytes(b"P6\nnope")
+        with pytest.raises(ValueError, match="not a PFM"):
+            dl.read_pfm(str(bad))
+
+    def test_google_drive_offline_fails_clearly(self, tmp_path, monkeypatch):
+        import urllib.error
+        import urllib.request
+
+        def boom(*a, **k):
+            raise urllib.error.URLError("no egress")
+
+        monkeypatch.setattr(urllib.request.OpenerDirector, "open", boom)
+        with pytest.raises(RuntimeError, match="Google Drive"):
+            dl.download_file_from_google_drive("abc123", str(tmp_path))
+
 
 class TestSynthetic:
     def test_cifar_learnable_structure(self):

@@ -58,8 +58,11 @@ def test_make_mesh_auto(devices8):
     assert m.shape["dp"] == 8
     m2 = make_mesh(("dp", "tp"), (4, 2), devices8)
     assert m2.shape["dp"] == 4 and m2.shape["tp"] == 2
+    # smaller than available -> first prod(shape) devices (device narrowing)
+    m3 = make_mesh(("dp",), (3,), devices8)
+    assert m3.size == 3 and list(np.ravel(m3.devices)) == devices8[:3]
     with pytest.raises(ValueError):
-        make_mesh(("dp",), (3,), devices8)
+        make_mesh(("dp",), (16,), devices8)
 
 
 def test_batch_sharding_runs_collective(mesh8):
@@ -68,6 +71,43 @@ def test_batch_sharding_runs_collective(mesh8):
     # a jit'd mean over a sharded batch must compile in a psum and match
     got = jax.jit(lambda a: a.mean())(xs)
     assert np.isclose(float(got), float(x.mean()))
+
+
+def test_zero1_shards_only_opt_state(mesh8):
+    # ZeRO-1 (ZeroRedundancyOptimizer analog, transformer_test.py:4,221-222):
+    # params replicated, optimizer state sharded over the data axis.
+    from faster_distributed_training_tpu.config import TrainConfig
+    from faster_distributed_training_tpu.models import resnet18
+    from faster_distributed_training_tpu.optim import build_optimizer
+    from faster_distributed_training_tpu.parallel.placement import (
+        make_put_batch, shard_train_state, train_state_shardings)
+    from faster_distributed_training_tpu.train import (create_train_state,
+                                                       make_train_step)
+
+    bs = 16
+    cfg = TrainConfig(model="resnet18", batch_size=bs, zero1=True,
+                      optimizer="sgd", precision="fp32", mixup_mode="none",
+                      epochs=1)
+    model = resnet18(num_classes=10)
+    tx, _ = build_optimizer(cfg, steps_per_epoch=2)
+    state = create_train_state(model, tx, jnp.zeros((bs, 32, 32, 3)),
+                               jax.random.PRNGKey(0),
+                               init_kwargs={"train": True})
+    shardings = train_state_shardings(state, mesh8, cfg)
+    # every param leaf replicated
+    assert all(s.spec == P()
+               for s in jax.tree.leaves(shardings.params))
+    # at least one big optimizer-state leaf sharded over dp
+    opt_specs = [s.spec for s in jax.tree.leaves(shardings.opt_state)]
+    assert any("dp" in tuple(sp) for sp in opt_specs), opt_specs
+    with mesh8:
+        state = shard_train_state(state, mesh8, cfg)
+        batch = make_put_batch(mesh8)({
+            "image": np.zeros((bs, 32, 32, 3), np.float32),
+            "label": np.arange(bs, dtype=np.int32) % 10})
+        step = jax.jit(make_train_step(cfg), donate_argnums=0)
+        state, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))
 
 
 def test_fsdp_partition_params(devices8):
