@@ -88,6 +88,18 @@ class TrainConfig:
     profile: bool = False
     plot: bool = True
 
+    # -- failure detection / debugging ------------------------------------
+    # The reference has neither (SURVEY.md §5: recovery = manual re-launch
+    # with --resume; its NGD NaN guard + never-enabled _self_test are the
+    # nearest analogs).  Both are deliberate do-better additions.
+    auto_recover: bool = False        # non-finite epoch loss -> restore the
+                                      # last good checkpoint and continue
+    max_recoveries: int = 2           # consecutive restores before giving up
+    debug: bool = False               # per-epoch NGD Fisher invariant checks
+                                      # (the reference's debug flag,
+                                      # ngd_optimizer.py:46, which it never
+                                      # turns on)
+
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
 
@@ -134,6 +146,11 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--checkpoint_dir", default=d.checkpoint_dir, type=str)
     p.add_argument("--profile", action="store_true", help="capture a jax.profiler trace")
     p.add_argument("--no_plot", action="store_true")
+    p.add_argument("--auto_recover", action="store_true",
+                   help="on a non-finite epoch loss, restore the last good "
+                        "checkpoint and keep training")
+    p.add_argument("--debug", action="store_true",
+                   help="per-epoch NGD Fisher invariant self-tests")
     p.add_argument("--seq_len", default=d.seq_len, type=int,
                    help="transformer max sequence length")
     p.add_argument("--n_layers", default=d.n_layers, type=int)
@@ -181,6 +198,7 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         data_dir=args.data_dir, subset_stride=args.subset_stride, seed=args.seed,
         checkpoint_dir=args.checkpoint_dir, profile=args.profile,
         plot=not args.no_plot,
+        auto_recover=args.auto_recover, debug=args.debug,
         seq_len=args.seq_len, n_layers=args.n_layers, d_model=args.d_model,
         d_ff=args.d_ff, n_heads=args.n_heads, attention=args.attention,
         mlp_impl=args.mlp_impl,

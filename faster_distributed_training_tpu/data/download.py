@@ -55,6 +55,10 @@ def download_url(url: str, root: str, filename: Optional[str] = None,
                     break
                 f.write(block)
     except (urllib.error.URLError, OSError) as e:
+        # never leave a partial file behind: check_integrity(md5=None)
+        # would return it as the dataset on the next call
+        if os.path.exists(path):
+            os.remove(path)
         raise RuntimeError(
             f"could not download {url} ({e}); in offline environments "
             f"place the file at {path} manually or use the synthetic "
@@ -140,6 +144,10 @@ def download_file_from_google_drive(file_id: str, root: str,
                 f"Google Drive id={file_id} returned an HTML page instead "
                 f"of the file (quota exceeded / permission denied?)")
     except (urllib.error.URLError, OSError) as e:
+        # a failed confirm hop leaves the interstitial HTML / partial
+        # payload at `path`; delete it or the next call caches it as data
+        if os.path.exists(path):
+            os.remove(path)
         raise RuntimeError(
             f"could not fetch Google Drive id={file_id} ({e}); place the "
             f"file at {path} manually") from e
@@ -164,6 +172,13 @@ def read_pfm(path: str):
         w, h = map(int, line.split())
         scale = float(f.readline().strip())
         endian = "<" if scale < 0 else ">"
-        data = np.frombuffer(f.read(), dtype=endian + "f4")
+        count = h * w * (3 if color else 1)
+        # exact count: writers commonly append a trailing newline after the
+        # raster, which would break a whole-file frombuffer+reshape
+        raw = f.read(4 * count)
+        if len(raw) != 4 * count:
+            raise ValueError(f"{path}: truncated PFM (got {len(raw)} of "
+                             f"{4 * count} raster bytes)")
+        data = np.frombuffer(raw, dtype=endian + "f4")
         shape = (h, w, 3) if color else (h, w)
         return data.reshape(shape)[::-1].astype(np.float32)

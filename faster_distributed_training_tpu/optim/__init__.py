@@ -15,7 +15,7 @@ SURVEY.md §7 flags).
 
 from faster_distributed_training_tpu.optim.ngd import (  # noqa: F401
     NGDHyperParams, OnlineNaturalGradientState, init_ng_state, ngd,
-    precondition, scale_by_ngd)
+    precondition, scale_by_ngd, self_test, self_test_all)
 from faster_distributed_training_tpu.optim.madgrad import (  # noqa: F401
     madgrad, mirror_madgrad)
 from faster_distributed_training_tpu.optim.schedules import (  # noqa: F401

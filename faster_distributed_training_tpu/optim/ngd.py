@@ -279,6 +279,104 @@ def precondition(state: OnlineNaturalGradientState, grad: jax.Array,
     return state, jnp.moveaxis(out.reshape(moved.shape), -1, axis)
 
 
+def self_test(w: jax.Array, d: jax.Array, rho: jax.Array,
+              hp: NGDHyperParams) -> Dict[str, jax.Array]:
+    """Jittable invariant check on one axis-state — the reference's
+    ``_self_test`` (``ngd_optimizer.py:330-345``) with asserts replaced by
+    a dict of on-device booleans (usable inside jit / under vmap):
+
+      * ``rho_floor``:   rho >= epsilon,
+      * ``d_floor``:     min(d) >= epsilon and min(d) > 0.9*delta*max(d),
+      * ``rho_vs_d``:    rho > 0.9*delta*max(d),
+      * ``orthonormal``: max|W W^T ∘ (e^-1/2 e^-1/2ᵀ) − I| < 0.1, where
+        e = 1/(beta/d + 1) — i.e. W's rows are orthogonal with squared
+        norms e_i (the factorization the update maintains).
+
+    ``ok`` is the conjunction.  The reference runs this only when
+    ``debug`` is set and on NaN detection; here it also backs
+    tests/test_optim.py's invariant checks after real update steps."""
+    dim = w.shape[1]
+    rank = w.shape[0]
+    d_max, d_min = jnp.max(d), jnp.min(d)
+    rho_floor = rho >= EPSILON
+    d_floor = jnp.logical_and(d_min >= EPSILON, d_min > DELTA * d_max * 0.9)
+    rho_vs_d = rho > DELTA * d_max * 0.9
+    beta = rho * (1.0 + hp.alpha) + hp.alpha * jnp.sum(d) / dim
+    e = 1.0 / (beta / d + 1.0)
+    inv_sqrt_e = 1.0 / jnp.sqrt(e)
+    should_be_zero = (w @ w.T) * jnp.outer(inv_sqrt_e, inv_sqrt_e) \
+        - jnp.eye(rank, dtype=w.dtype)
+    orthonormal = jnp.max(jnp.abs(should_be_zero)) < 0.1
+    ok = rho_floor & d_floor & rho_vs_d & orthonormal
+    return {"ok": ok, "rho_floor": rho_floor, "d_floor": d_floor,
+            "rho_vs_d": rho_vs_d, "orthonormal": orthonormal}
+
+
+def self_test_all(opt_state,
+                  hp: Optional[NGDHyperParams] = None) -> Dict[str, Any]:
+    """Validate every Fisher factor inside an optimizer state tree.
+
+    Walks `opt_state` (e.g. the whole optax chain state) for
+    ScaleByNGDState leaves and runs `self_test` on each grouped /
+    ungrouped axis-state that has been initialized (t > 0).  Returns
+    {"ok": bool, "failures": [(name, check_dict), ...]} with everything
+    pulled to host — this is a debugging/validation surface, not a step
+    -time path (cf. ngd_optimizer.py:46 `debug` flag).
+
+    Groups whose direction count n is below the factor rank are SKIPPED
+    (reported in "skipped"): with fewer than `rank` rows per step the
+    rank-R factorization is under-determined and the orthonormality
+    invariant legitimately does not hold — verified against the torch
+    reference, whose own `_self_test` fails on e.g. a bias vector
+    (N=1, dim=8, rank=4); it goes unnoticed there only because `debug`
+    defaults to False.
+
+    Pass the run's actual `hp` when alpha differs from the default — the
+    orthonormality target e = 1/(beta/d + 1) depends on it.
+
+    Ungrouped axis-states (scale_by_ngd(grouped=False)) carry no record
+    of their direction count, so the under-determined case cannot be
+    detected there; for them only the floor invariants gate `ok` and the
+    orthonormality result is reported per-state without failing the
+    check."""
+    failures = []
+    skipped = []
+    checked = 0
+
+    def check(name, w, d, rho, hp, gate_orthonormal=True):
+        nonlocal checked
+        checked += 1
+        res = jax.device_get(self_test(w, d, rho, hp))
+        ok = bool(res["ok"]) if gate_orthonormal else bool(
+            res["rho_floor"] & res["d_floor"] & res["rho_vs_d"])
+        if not ok:
+            failures.append((name, {k: bool(v) for k, v in res.items()}))
+
+    hp = hp or NGDHyperParams()  # invariants depend on alpha
+    for s in jax.tree.leaves(
+            opt_state, is_leaf=lambda x: isinstance(x, ScaleByNGDState)):
+        if not isinstance(s, ScaleByNGDState):
+            continue
+        if int(jax.device_get(s.t)) == 0:
+            continue  # never preconditioned — factors still at defaults
+        for key, g in s.groups.items():
+            # key format: "r{axis}:n{rows}:d{dim}:k{rank}" (_group_key)
+            parts = {p[0]: int(p[1:]) for p in key.split(":")}
+            if parts.get("n", 0) < parts.get("k", 0):
+                skipped.append(key)
+                continue
+            for i in range(g.w.shape[0]):
+                check(f"group[{key}][{i}]", g.w[i], g.d[i], g.rho[i], hp)
+        for leaf_states in jax.tree.leaves(
+                s.axes, is_leaf=lambda x: isinstance(
+                    x, OnlineNaturalGradientState)):
+            if isinstance(leaf_states, OnlineNaturalGradientState):
+                check("axis_state", leaf_states.w, leaf_states.d,
+                      leaf_states.rho, hp, gate_orthonormal=False)
+    return {"ok": not failures, "checked": checked, "failures": failures,
+            "skipped": skipped}
+
+
 # ---------------------------------------------------------------------------
 # optax wiring
 # ---------------------------------------------------------------------------

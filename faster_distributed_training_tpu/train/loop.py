@@ -29,6 +29,13 @@ from faster_distributed_training_tpu.utils.profiling import peak_memory_bytes
 LoaderFn = Callable[[int], Iterable[Dict[str, Any]]]
 
 
+def _finite(x) -> bool:
+    try:
+        return x is not None and bool(jax.numpy.isfinite(x))
+    except Exception:
+        return False
+
+
 class Trainer:
     """Owns the compiled steps and the epoch loop."""
 
@@ -48,6 +55,7 @@ class Trainer:
             "train_acc": [], "test_acc": [], "train_loss": [],
             "test_loss": [], "epoch_time": []}
         self.best_acc = 0.0
+        self.recoveries = 0
 
     def run_epoch(self, state: TrainState, loader: Iterable) -> tuple:
         acc = MetricAccumulator()
@@ -76,9 +84,41 @@ class Trainer:
             eval_loader: LoaderFn, ckpt_name: str = "ckpt",
             start_epoch: int = 0) -> TrainState:
         cfg = self.cfg
-        for epoch in range(start_epoch, cfg.epochs):
+        self.recoveries = 0
+        consecutive_failures = 0
+        if (cfg.auto_recover
+                and not ckpt.has_checkpoint(cfg.checkpoint_dir, ckpt_name)):
+            # guarantee a restore point: once an fp32 epoch goes non-finite
+            # the live params are already poisoned, so "retry from current
+            # state" can never converge — snapshot the starting state.
+            ckpt.save_checkpoint(cfg.checkpoint_dir, ckpt_name, state,
+                                 start_epoch - 1, self.best_acc)
+        epoch = start_epoch
+        while epoch < cfg.epochs:
             state, train_m, elapsed = self.run_epoch(state,
                                                      train_loader(epoch))
+            # Failure detection (a deliberate addition — the reference's
+            # only recovery is manual re-launch with --resume, SURVEY.md
+            # §5): a non-finite epoch loss means the run is poisoned; roll
+            # back to the last good checkpoint and keep going.
+            if cfg.auto_recover and not _finite(train_m.get("loss")):
+                consecutive_failures += 1
+                if consecutive_failures > cfg.max_recoveries:
+                    raise RuntimeError(
+                        f"training diverged {consecutive_failures} times in "
+                        f"a row (epoch {epoch}); giving up")
+                state, ck_epoch, best = ckpt.restore_checkpoint(
+                    cfg.checkpoint_dir, ckpt_name, state)
+                self.best_acc = best
+                self.log(f"[recover] non-finite loss at epoch {epoch}; "
+                         f"restored checkpoint from epoch {ck_epoch}, "
+                         f"retrying")
+                self.recoveries += 1
+                epoch += 1  # a fresh data order; same LR schedule position
+                continue
+            consecutive_failures = 0
+            if cfg.debug:
+                self._debug_checks(state, epoch)
             test_m = self.evaluate(state, eval_loader(epoch))
             self.history["train_acc"].append(train_m.get("accuracy", 0.0))
             self.history["train_loss"].append(train_m.get("loss", 0.0))
@@ -98,7 +138,25 @@ class Trainer:
                 self.best_acc = test_m["accuracy"]
                 ckpt.save_checkpoint(cfg.checkpoint_dir, ckpt_name, state,
                                      epoch, self.best_acc)
+            epoch += 1
         return state
+
+    def _debug_checks(self, state: TrainState, epoch: int) -> None:
+        """--debug: the reference's never-enabled NGD `_self_test`
+        (ngd_optimizer.py:46,330-345), run for real once per epoch."""
+        from faster_distributed_training_tpu.optim.ngd import (
+            NGDHyperParams, self_test_all)
+
+        cfg = self.cfg
+        res = self_test_all(state.opt_state, NGDHyperParams(
+            alpha=cfg.ngd_alpha, rank=cfg.ngd_rank,
+            update_period=cfg.ngd_update_period, eta=cfg.ngd_eta))
+        if res["checked"] and not res["ok"]:
+            self.log(f"[debug] epoch {epoch}: NGD Fisher invariant "
+                     f"violations: {res['failures']}")
+        elif res["checked"]:
+            self.log(f"[debug] epoch {epoch}: NGD invariants OK "
+                     f"({res['checked']} factor states)")
 
     def maybe_resume(self, state: TrainState, ckpt_name: str = "ckpt"
                      ) -> tuple:

@@ -7,8 +7,16 @@ import os
 os.environ["JAX_PLATFORMS"] = os.environ.get("FDT_TEST_PLATFORM", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = flags + " --xla_force_host_platform_device_count=8"
+if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
+    # 8 virtual device threads can share ONE physical core here; XLA's CPU
+    # collective rendezvous aborts the process if a participant is >40s late
+    # (rendezvous.cc), which a starved thread legitimately can be.  Raise the
+    # warn/terminate timeouts so slow scheduling is slow, not fatal.
+    flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
+              " --xla_cpu_collective_call_terminate_timeout_seconds=1800"
+              " --xla_cpu_collective_timeout_seconds=1800")
+os.environ["XLA_FLAGS"] = flags.strip()
 
 import jax  # noqa: E402
 import pytest  # noqa: E402

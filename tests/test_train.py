@@ -173,3 +173,51 @@ class TestCheckpoint:
         for a, b in zip(jax.tree.leaves(restored.opt_state),
                         jax.tree.leaves(state.opt_state)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestFailureRecovery:
+    """--auto_recover: non-finite epoch loss rolls back to the last good
+    checkpoint and training continues (deliberate do-better addition —
+    the reference's only recovery is manual re-launch with --resume,
+    SURVEY.md §5)."""
+
+    def _trainer_setup(self, tmp_path, epochs=3):
+        from faster_distributed_training_tpu.train import Trainer
+        from faster_distributed_training_tpu.train import checkpoint as ckpt
+        cfg = TrainConfig(model="resnet18", batch_size=8, lr=1e-3,
+                          optimizer="sgd", precision="fp32", epochs=epochs,
+                          mixup_mode="none", alpha=0.0, donate=False,
+                          auto_recover=True, max_recoveries=2,
+                          checkpoint_dir=str(tmp_path))
+        model = resnet18(num_classes=10)
+        tx, _ = build_optimizer(cfg, steps_per_epoch=1)
+        sample = jnp.zeros((8, 32, 32, 3), jnp.float32)
+        state = create_train_state(model, tx, sample, jax.random.PRNGKey(0),
+                                   init_kwargs={"train": False})
+        ckpt.save_checkpoint(str(tmp_path), "t", state, epoch=-1, best_acc=0.0)
+        good = {"image": np.random.default_rng(0).normal(
+                    size=(8, 32, 32, 3)).astype(np.float32),
+                "label": np.arange(8, dtype=np.int32) % 10}
+        bad = {**good, "image": np.full((8, 32, 32, 3), np.nan, np.float32)}
+        return cfg, state, good, bad, Trainer(cfg, log=lambda *_: None)
+
+    def test_recovers_from_nan_epoch(self, tmp_path):
+        cfg, state, good, bad, trainer = self._trainer_setup(tmp_path)
+
+        def train_loader(epoch):
+            return [bad if epoch == 0 else good]
+
+        state = trainer.fit(state, train_loader, lambda e: [good],
+                            ckpt_name="t")
+        assert trainer.recoveries == 1
+        # post-recovery training really happened, from the restored state
+        assert np.isfinite(
+            float(jax.tree.leaves(state.params)[0].sum()))
+        assert int(state.step) == cfg.epochs - 1  # one epoch was rolled back
+
+    def test_gives_up_after_max_recoveries(self, tmp_path):
+        cfg, state, good, bad, trainer = self._trainer_setup(tmp_path,
+                                                             epochs=5)
+        with pytest.raises(RuntimeError, match="diverged"):
+            trainer.fit(state, lambda e: [bad], lambda e: [good],
+                        ckpt_name="t")
