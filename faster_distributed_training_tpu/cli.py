@@ -196,6 +196,16 @@ def make_loaders(cfg: TrainConfig, train_ds, eval_ds, dp: int = 1
                          f"{pc} processes")
     local_bs = cfg.batch_size // pc
 
+    if cfg.debug:
+        # multi-host data contract: local partition algebra + cross-host
+        # agreement on the actual sharding inputs (collective)
+        from faster_distributed_training_tpu.data import (
+            verify_host_shards, verify_host_shards_global)
+        n_train = (len(train_ds) if hasattr(train_ds, "encode_batch")
+                   else len(train_ds[0]))
+        verify_host_shards(n_train, epoch=0, seed=cfg.seed)
+        verify_host_shards_global(n_train, epoch=0, seed=cfg.seed)
+
     def train_loader(epoch: int):
         return PrefetchIterator(
             BatchLoader(train_ds, local_bs, epoch=epoch, seed=cfg.seed,

@@ -40,6 +40,78 @@ def shard_for_host(n: int, epoch: int, seed: int = 0, shuffle: bool = True,
     return order[pi * per:(pi + 1) * per]
 
 
+def verify_host_shards(n: int, epoch: int, seed: int = 0,
+                       shuffle: bool = True,
+                       process_count: Optional[int] = None) -> None:
+    """LOCAL validation of the sharding algebra: simulating every process
+    with THIS host's (n, seed, epoch) config, the shards must be pairwise
+    disjoint and tile exactly the first (n // pc) * pc entries of one
+    global permutation.  This checks the partition logic and this host's
+    config; it cannot see another host's actual state — for that, use
+    ``verify_host_shards_global``.  O(n) host-side; run under ``--debug``
+    or in tests, not per step."""
+    pc = jax.process_count() if process_count is None else process_count
+    shards = [shard_for_host(n, epoch, seed, shuffle, pi, pc)
+              for pi in range(pc)]
+    allidx = np.concatenate(shards)
+    if len(np.unique(allidx)) != len(allidx):
+        raise AssertionError(
+            f"host shards overlap (epoch {epoch}, {pc} processes): "
+            f"{len(allidx) - len(np.unique(allidx))} duplicated samples")
+    per = n // pc
+    if len(allidx) != per * pc:
+        raise AssertionError(
+            f"host shards mis-sized: {len(allidx)} != {per * pc}")
+    full = np.random.default_rng((seed, epoch)).permutation(n) if shuffle \
+        else np.arange(n)
+    if not np.array_equal(np.sort(allidx), np.sort(full[:per * pc])):
+        raise AssertionError("host shards do not tile the global permutation")
+
+
+def _check_shard_digests(digests: np.ndarray) -> None:
+    """Pure cross-host consistency check on stacked per-host digests
+    (rows: [n, process_count, seed, epoch, shard_crc]).  Raises when hosts
+    disagree on the sharding inputs (different dataset size / world size /
+    seed / epoch — i.e. different global permutations: the set_epoch-style
+    desync, SURVEY.md §5) or when two hosts hold byte-identical shards
+    (every rank reading the same data: the forgotten-DistributedSampler
+    failure mode, resnet50_test.py:331)."""
+    digests = np.asarray(digests)
+    for col, what in ((0, "dataset size n"), (1, "process_count"),
+                      (2, "seed"), (3, "epoch")):
+        if not (digests[:, col] == digests[0, col]).all():
+            raise AssertionError(
+                f"hosts disagree on {what}: {digests[:, col].tolist()} — "
+                f"each host is drawing from a different permutation")
+    if digests.shape[0] > 1:
+        crcs = digests[:, 4]
+        if len(np.unique(crcs)) != len(crcs):
+            raise AssertionError(
+                "two hosts hold identical data shards — every rank is "
+                "loading the same slice (DistributedSampler-forgotten bug)")
+
+
+def verify_host_shards_global(n: int, epoch: int, seed: int = 0,
+                              shuffle: bool = True) -> None:
+    """CROSS-HOST validation: allgathers each host's actual sharding inputs
+    + a CRC of its real index shard and checks agreement/disjointness
+    (see _check_shard_digests).  Agreement on (n, pc, seed, epoch) plus the
+    locally-verified algebra implies globally disjoint shards.  No-op
+    guarantees on a single process.  Collective — every process must call
+    it at the same point."""
+    import zlib
+
+    shard = shard_for_host(n, epoch, seed, shuffle)
+    digest = np.asarray([n, jax.process_count(), seed, epoch,
+                         zlib.crc32(np.ascontiguousarray(shard).tobytes())],
+                        dtype=np.int64)
+    if jax.process_count() == 1:
+        _check_shard_digests(digest[None])
+        return
+    from jax.experimental import multihost_utils
+    _check_shard_digests(multihost_utils.process_allgather(digest))
+
+
 class BatchLoader:
     """Iterates dict batches from an array dataset (images) or an
     ``encode_batch``-style text dataset, host-sharded, drop_last."""

@@ -178,7 +178,13 @@ class ResNet(nn.Module):
     num_classes: int = 10
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
-    remat: bool = False
+    remat: bool = False   # checkpoint every residual block.  Measured on
+                          # v5e @ bs=1024 bf16 NGD: 3196 vs 3858 img/s/chip
+                          # — the step is HBM-bound and block-recompute adds
+                          # more traffic than it saves, so this stays OFF by
+                          # default; it is a memory lever for bigger batches,
+                          # not a speed lever (cf. conv_bn.py's per-conv
+                          # recompute, which IS the faster path).
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:

@@ -86,12 +86,16 @@ class Trainer:
         cfg = self.cfg
         self.recoveries = 0
         consecutive_failures = 0
-        if (cfg.auto_recover
-                and not ckpt.has_checkpoint(cfg.checkpoint_dir, ckpt_name)):
-            # guarantee a restore point: once an fp32 epoch goes non-finite
-            # the live params are already poisoned, so "retry from current
-            # state" can never converge — snapshot the starting state.
-            ckpt.save_checkpoint(cfg.checkpoint_dir, ckpt_name, state,
+        recover_name = ckpt_name + "_last"
+        if cfg.auto_recover:
+            # Rollback target is a ROLLING last-good snapshot, separate from
+            # the best-accuracy checkpoint (which can be arbitrarily stale
+            # after a plateau).  Written unconditionally here so (a) a
+            # restore point always exists — once an fp32 epoch goes
+            # non-finite the live params are poisoned, "retry from current
+            # state" can never converge — and (b) a stale snapshot from a
+            # previous run in the same dir can never be resurrected.
+            ckpt.save_checkpoint(cfg.checkpoint_dir, recover_name, state,
                                  start_epoch - 1, self.best_acc)
         epoch = start_epoch
         while epoch < cfg.epochs:
@@ -107,16 +111,27 @@ class Trainer:
                     raise RuntimeError(
                         f"training diverged {consecutive_failures} times in "
                         f"a row (epoch {epoch}); giving up")
-                state, ck_epoch, best = ckpt.restore_checkpoint(
-                    cfg.checkpoint_dir, ckpt_name, state)
-                self.best_acc = best
+                state, ck_epoch, _ = ckpt.restore_checkpoint(
+                    cfg.checkpoint_dir, recover_name, state)
                 self.log(f"[recover] non-finite loss at epoch {epoch}; "
-                         f"restored checkpoint from epoch {ck_epoch}, "
+                         f"restored last-good state from epoch {ck_epoch}, "
                          f"retrying")
                 self.recoveries += 1
-                epoch += 1  # a fresh data order; same LR schedule position
+                # epoch += 1 gives the retry a fresh data order.  Note the
+                # restore rolls state.step (and the optax schedule position
+                # inside opt_state) back to the snapshot's value, so the
+                # retried epoch trains at the snapshot's LR — the epoch
+                # counter and the schedule deliberately diverge by the
+                # rolled-back amount.
+                epoch += 1
                 continue
             consecutive_failures = 0
+            if cfg.auto_recover:
+                # refresh the rolling last-good snapshot after every finite
+                # epoch, so recovery rolls back one epoch, not to the last
+                # best-accuracy improvement
+                ckpt.save_checkpoint(cfg.checkpoint_dir, recover_name, state,
+                                     epoch, self.best_acc)
             if cfg.debug:
                 self._debug_checks(state, epoch)
             test_m = self.evaluate(state, eval_loader(epoch))

@@ -185,3 +185,62 @@ class TestSynthetic:
         same = np.linalg.norm(c0[0] - c0[1])
         cross = np.linalg.norm(c0[0] - c1[0])
         assert same < cross
+
+
+class TestShardConsistency:
+    """verify_host_shards: the DistributedSampler-equivalent contract —
+    disjoint per-host shards tiling one global permutation (guards the
+    silent duplicated-data failure mode, SURVEY.md §5 missing set_epoch)."""
+
+    def test_shards_disjoint_and_cover(self):
+        from faster_distributed_training_tpu.data import verify_host_shards
+        for pc in (1, 2, 4, 8):
+            verify_host_shards(1000, epoch=3, seed=7, process_count=pc)
+
+    def test_epoch_changes_order_but_not_partition(self):
+        from faster_distributed_training_tpu.data import shard_for_host
+        a = shard_for_host(100, epoch=0, seed=1, process_index=0,
+                           process_count=4)
+        b = shard_for_host(100, epoch=1, seed=1, process_index=0,
+                           process_count=4)
+        assert not np.array_equal(a, b)  # reshuffled (set_epoch semantics)
+
+    def test_detects_desynced_permutations(self):
+        # simulate the bug: one host on a different epoch's permutation
+        from faster_distributed_training_tpu.data import shard_for_host
+        shards = [shard_for_host(64, epoch=0, seed=1, process_index=pi,
+                                 process_count=2) for pi in range(2)]
+        desync = shard_for_host(64, epoch=1, seed=1, process_index=1,
+                                process_count=2)
+        merged = np.concatenate([shards[0], desync])
+        assert len(np.unique(merged)) != 64  # overlap exists -> detectable
+
+    def test_global_digest_check(self):
+        import zlib
+        import pytest
+        from faster_distributed_training_tpu.data import (
+            shard_for_host, verify_host_shards_global)
+        from faster_distributed_training_tpu.data.loader import (
+            _check_shard_digests)
+
+        verify_host_shards_global(100, epoch=0, seed=1)  # 1-process no-op
+
+        def digest(n, pc, seed, epoch, pi):
+            s = shard_for_host(n, epoch, seed, True, pi, pc)
+            return [n, pc, seed, epoch, zlib.crc32(s.tobytes())]
+
+        # healthy 4-host run
+        _check_shard_digests(np.asarray(
+            [digest(100, 4, 1, 3, pi) for pi in range(4)]))
+        # epoch desync: one host a step behind
+        with pytest.raises(AssertionError, match="epoch"):
+            _check_shard_digests(np.asarray(
+                [digest(100, 4, 1, 3, 0), digest(100, 4, 1, 2, 1)]))
+        # seed desync
+        with pytest.raises(AssertionError, match="seed"):
+            _check_shard_digests(np.asarray(
+                [digest(100, 4, 1, 3, 0), digest(100, 4, 9, 3, 1)]))
+        # forgotten sharding: every host holds the identical full slice
+        with pytest.raises(AssertionError, match="identical"):
+            _check_shard_digests(np.asarray(
+                [digest(100, 1, 1, 3, 0), digest(100, 1, 1, 3, 0)]))
