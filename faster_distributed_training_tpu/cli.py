@@ -189,6 +189,7 @@ def make_loaders(cfg: TrainConfig, train_ds, eval_ds, dp: int = 1
 
     from faster_distributed_training_tpu.data import (BatchLoader,
                                                       PrefetchIterator)
+    from faster_distributed_training_tpu.data.loader import dataset_len
 
     pc = jax.process_count()
     if cfg.batch_size % pc:
@@ -201,8 +202,7 @@ def make_loaders(cfg: TrainConfig, train_ds, eval_ds, dp: int = 1
         # agreement on the actual sharding inputs (collective)
         from faster_distributed_training_tpu.data import (
             verify_host_shards, verify_host_shards_global)
-        n_train = (len(train_ds) if hasattr(train_ds, "encode_batch")
-                   else len(train_ds[0]))
+        n_train = dataset_len(train_ds)
         verify_host_shards(n_train, epoch=0, seed=cfg.seed)
         verify_host_shards_global(n_train, epoch=0, seed=cfg.seed)
 
@@ -215,8 +215,7 @@ def make_loaders(cfg: TrainConfig, train_ds, eval_ds, dp: int = 1
     # drop_last + a small (e.g. subset-strided) eval split can starve eval
     # entirely; clamp so at least one eval batch always exists, keeping the
     # global eval batch divisible by the data-parallel world size
-    n_eval = (len(eval_ds) if hasattr(eval_ds, "encode_batch")
-              else len(eval_ds[0]))
+    n_eval = dataset_len(eval_ds)
     per_shard = max(dp // pc, 1)     # device shards fed from this host
     eval_bs = min(local_bs, n_eval // pc)
     eval_bs -= eval_bs % per_shard   # global eval batch must divide dp

@@ -197,7 +197,7 @@ class TestShardConsistency:
         for pc in (1, 2, 4, 8):
             verify_host_shards(1000, epoch=3, seed=7, process_count=pc)
 
-    def test_epoch_changes_order_but_not_partition(self):
+    def test_epoch_reshuffles_shard(self):
         from faster_distributed_training_tpu.data import shard_for_host
         a = shard_for_host(100, epoch=0, seed=1, process_index=0,
                            process_count=4)

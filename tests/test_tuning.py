@@ -1,0 +1,85 @@
+"""Tuning-harness tests: grid parsing, an end-to-end 2-trial sweep with
+JSON aggregation (the reference's tuning/ bash-grid capability, SURVEY.md
+§3.5 — which never aggregated results), and the vmapped-trials mode."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tuning.sweep import parse_grid, run_sweep  # noqa: E402
+from faster_distributed_training_tpu.config import TrainConfig  # noqa: E402
+
+
+class TestGridParse:
+    def test_parse_grid(self):
+        g = parse_grid(["alpha=0.2,0.4", "gamma=0.1"])
+        assert g == {"alpha": [0.2, 0.4], "gamma": [0.1]}
+
+    def test_bad_entry(self):
+        with pytest.raises(SystemExit):
+            parse_grid(["alpha"])
+
+
+class TestSweep:
+    def test_two_trial_sweep_aggregates_json(self, tmp_path):
+        base = TrainConfig(model="resnet18", dataset="synthetic",
+                           num_classes=10, batch_size=32, epochs=1,
+                           subset_stride=64, optimizer="sgd", lr=0.01,
+                           mixup_mode="none", alpha=0.0, precision="fp32",
+                           device="cpu",
+                           checkpoint_dir=str(tmp_path / "ck"))
+        out = str(tmp_path / "results.json")
+        results = run_sweep(base, {"lr": [0.01, 0.05]}, out_path=out)
+        assert len(results) == 2
+        assert {r["params"]["lr"] for r in results} == {0.01, 0.05}
+        with open(out) as f:
+            on_disk = json.load(f)
+        assert len(on_disk) == 2
+        assert all(np.isfinite(r["best_acc"]) for r in on_disk)
+        # ranked best-first
+        assert results[0]["best_acc"] >= results[-1]["best_acc"]
+
+    def test_int_fields_stay_int(self, tmp_path):
+        # the float grid parse must not turn epochs=1.0 into a float config
+        base = TrainConfig(model="resnet18", dataset="synthetic",
+                           batch_size=32, epochs=2, subset_stride=128,
+                           optimizer="sgd", mixup_mode="none", alpha=0.0,
+                           precision="fp32", device="cpu",
+                           checkpoint_dir=str(tmp_path / "ck"))
+        results = run_sweep(base, {"epochs": [1]},
+                            out_path=str(tmp_path / "r.json"))
+        assert results[0]["params"]["epochs"] == 1
+        assert isinstance(results[0]["params"]["epochs"], int)
+
+
+class TestVmapTrials:
+    def test_k_trials_one_program(self):
+        from flax import linen as nn
+        import jax.numpy as jnp
+
+        from tuning.vmap_sweep import vmap_trials
+
+        class TinyCNN(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=True):
+                x = nn.relu(nn.Conv(8, (3, 3))(x))
+                x = jnp.mean(x, axis=(1, 2))
+                return nn.Dense(10)(x)
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 32, 32, 3)).astype(np.float32)
+        y = (rng.integers(0, 10, size=(64,))).astype(np.int32)
+        cfg = TrainConfig(model="resnet18", batch_size=32, epochs=1, seed=1)
+        out = vmap_trials(cfg, lrs=[0.01, 0.1, 0.3], alphas=[0.0, 0.2, 0.4],
+                          data=(x, y), optimizer="sgd", steps=3,
+                          model=TinyCNN())
+        assert out["final_loss"].shape == (3,)
+        assert out["loss_curve"].shape == (3, 3)  # (steps, K)
+        assert np.isfinite(out["final_loss"]).all()
+        # distinct hyperparameters produced distinct trajectories
+        assert len({round(float(v), 6) for v in out["final_loss"]}) > 1

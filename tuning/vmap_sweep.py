@@ -60,7 +60,8 @@ def vmap_trials(cfg: TrainConfig,
                 data: Tuple[np.ndarray, np.ndarray],
                 optimizer: str = "sgd",
                 steps: Optional[int] = None,
-                mesh=None) -> Dict[str, np.ndarray]:
+                mesh=None,
+                model=None) -> Dict[str, np.ndarray]:
     """Train K=len(lrs) trials in one vmapped program; returns per-trial
     final loss / train accuracy arrays.
 
@@ -68,13 +69,18 @@ def vmap_trials(cfg: TrainConfig,
     NHWC float, labels) tuple; every trial sees the same batch stream
     (common random numbers — variance reduction for the grid comparison).
     With `mesh`, trial-axis leaves are sharded over the `dp` axis.
+    `model` overrides the cfg.model lookup with an arbitrary Flax module
+    (tests use a tiny CNN — vmapping a full ResNet multiplies its already
+    large graph by K, which the single-core CPU compiler chews on for
+    many minutes).
     """
     lrs = jnp.asarray(list(lrs), jnp.float32)
     alphas = jnp.asarray(list(alphas), jnp.float32)
     K = lrs.shape[0]
     assert alphas.shape[0] == K, "lrs and alphas must have equal length"
 
-    model = get_model(cfg.model, cfg.num_classes)
+    model = model if model is not None else get_model(cfg.model,
+                                                      cfg.num_classes)
     tx = _make_tx(optimizer)
     x_all, y_all = data
     x_all = jnp.asarray(x_all, jnp.float32)
