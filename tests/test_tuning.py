@@ -76,10 +76,11 @@ class TestVmapTrials:
         y = (rng.integers(0, 10, size=(64,))).astype(np.int32)
         cfg = TrainConfig(model="resnet18", batch_size=32, epochs=1, seed=1)
         out = vmap_trials(cfg, lrs=[0.01, 0.1, 0.3], alphas=[0.0, 0.2, 0.4],
-                          data=(x, y), optimizer="sgd", steps=3,
+                          data=(x, y), optimizer="sgd", steps=4,
                           model=TinyCNN())
         assert out["final_loss"].shape == (3,)
-        assert out["loss_curve"].shape == (3, 3)  # (steps, K)
+        assert out["loss_curve"].shape == (4, 3)  # (steps, K) — steps != K
+                                                  # so axis order is pinned
         assert np.isfinite(out["final_loss"]).all()
         # distinct hyperparameters produced distinct trajectories
         assert len({round(float(v), 6) for v in out["final_loss"]}) > 1
