@@ -170,7 +170,7 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                          n_layers=cfg.n_layers, d_model=cfg.d_model,
                          d_ff=cfg.d_ff, h=cfg.n_heads,
                          attention_impl=impl, mlp_impl=mlp_impl,
-                         mesh=mesh if impl == "ring" else None,
+                         mesh=mesh if impl in ("ring", "ulysses") else None,
                          alpha=cfg.alpha if cfg.alpha > 0 else 0.99,
                          dtype=dtype, remat=cfg.remat)
     return get_model(cfg.model, cfg.num_classes, dtype=dtype,

@@ -78,7 +78,7 @@ class TrainConfig:
     d_model: int = 512
     d_ff: int = 1024
     n_heads: int = 8
-    attention: str = ""               # "" auto | dense | flash | ring
+    attention: str = ""               # "" auto | dense | flash | ring | ulysses
     mlp_impl: str = ""                # "" auto (pallas on TPU) | fused | pallas
 
     # -- bookkeeping ------------------------------------------------------
@@ -158,9 +158,11 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--d_ff", default=d.d_ff, type=int)
     p.add_argument("--n_heads", default=d.n_heads, type=int)
     p.add_argument("--attention", default=d.attention,
-                   choices=["", "dense", "flash", "ring"],
+                   choices=["", "dense", "flash", "ring", "ulysses"],
                    help="attention impl ('' = ring when the mesh has an sp "
-                        "axis, flash on TPU, else dense)")
+                        "axis, flash on TPU, else dense; ulysses = "
+                        "all-to-all sequence parallelism, needs heads %% sp "
+                        "== 0)")
     p.add_argument("--mlp_impl", default=d.mlp_impl,
                    choices=["", "fused", "pallas"],
                    help="classifier MLP kernel ('' = pallas on TPU, else "

@@ -119,16 +119,19 @@ class MultiheadAttention(nn.Module):
       dense — O(L²) ScaledDotProduct with prob dropout (the reference);
       flash — Pallas TPU kernel / blockwise fallback (ops/flash_attention);
       ring  — sequence-parallel ring attention over `sp_axis` of `mesh`
-              (ops/ring_attention).  flash/ring never materialize the
-              probability tensor, so attention-prob dropout is skipped
-              there by construction.
+              (ops/ring_attention);
+      ulysses — sequence-parallel all-to-all head/sequence swap over
+              `sp_axis` (ops/ulysses_attention; needs h %% sp == 0).
+              flash/ring/ulysses never materialize the probability
+              tensor, so attention-prob dropout is skipped there by
+              construction.
     """
     h: int
     d_model: int
     dropout: float = 0.1
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
-    attention_impl: str = "dense"     # dense | flash | ring
+    attention_impl: str = "dense"     # dense | flash | ring | ulysses
     mesh: Optional[Any] = None        # required for ring
     sp_axis: str = "sp"
 
@@ -147,14 +150,19 @@ class MultiheadAttention(nn.Module):
             from faster_distributed_training_tpu.ops.flash_attention import (
                 flash_attention)
             ctx = flash_attention(q, k, v, mask=mask)
-        elif self.attention_impl == "ring":
-            from faster_distributed_training_tpu.ops.ring_attention import (
-                ring_self_attention)
+        elif self.attention_impl in ("ring", "ulysses"):
             if self.mesh is None:
-                raise ValueError("attention_impl='ring' needs a mesh with "
-                                 f"an {self.sp_axis!r} axis")
-            ctx = ring_self_attention(q, k, v, mask, self.mesh,
-                                      sp_axis=self.sp_axis)
+                raise ValueError(
+                    f"attention_impl={self.attention_impl!r} needs a mesh "
+                    f"with an {self.sp_axis!r} axis")
+            if self.attention_impl == "ring":
+                from faster_distributed_training_tpu.ops.ring_attention import (
+                    ring_self_attention as sp_attention)
+            else:
+                from faster_distributed_training_tpu.ops.ulysses_attention import (
+                    ulysses_self_attention as sp_attention)
+            ctx = sp_attention(q, k, v, mask, self.mesh,
+                               sp_axis=self.sp_axis)
         else:
             rng = (self.make_rng("dropout")
                    if (self.dropout > 0 and train) else None)
@@ -201,9 +209,9 @@ class Transformer(nn.Module):
     alpha: float = 0.99           # in-forward mixup Beta parameter
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
-    attention_impl: str = "dense"  # dense | flash | ring
+    attention_impl: str = "dense"  # dense | flash | ring | ulysses
     mlp_impl: str = "fused"        # fused (custom_vjp) | pallas
-    mesh: Optional[Any] = None     # required for attention_impl='ring'
+    mesh: Optional[Any] = None     # required for ring/ulysses
     sp_axis: str = "sp"
     remat: bool = False
 

@@ -17,3 +17,5 @@ from faster_distributed_training_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention)
 from faster_distributed_training_tpu.ops.ring_attention import (  # noqa: F401
     ring_attention, ring_self_attention)
+from faster_distributed_training_tpu.ops.ulysses_attention import (  # noqa: F401
+    ulysses_attention, ulysses_self_attention)

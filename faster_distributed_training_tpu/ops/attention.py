@@ -68,10 +68,15 @@ def finalize(m: jax.Array, l: jax.Array, acc: jax.Array,
 
 
 def init_carry(q: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    lead = q.shape[:-1]          # [..., Lq]
-    m = jnp.full(lead, -jnp.inf, jnp.float32)
-    l = jnp.zeros(lead, jnp.float32)
-    acc = jnp.zeros(q.shape[:-1] + (q.shape[-1],), jnp.float32)
+    # accumulators are DERIVED from q (0*q) rather than freshly created:
+    # under shard_map, constants carry no varying-manual-axes while the
+    # scan-body outputs vary over the mesh axes, and lax.scan requires the
+    # carry types (incl. VMA sets) to match — deriving from q gives the
+    # carry q's full VMA set (same trick as ops/ring_attention.py).
+    zeros = q.astype(jnp.float32) * 0.0
+    m = zeros[..., 0] - jnp.inf
+    l = zeros[..., 0]
+    acc = zeros
     return m, l, acc
 
 

@@ -19,13 +19,12 @@ rotation), so the backward pass is ring-parallel too; the scan body is
 from __future__ import annotations
 
 import math
-from functools import partial
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from faster_distributed_training_tpu.ops.attention import (
     NEG_INF, finalize, mask_to_bias, online_block_update)
@@ -83,8 +82,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return finalize(m, l, acc, q.dtype)
 
 
-def _batch_axes(mesh: Mesh) -> Tuple[str, ...]:
-    return tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+def _ring_body(q, k, v, axis_name, key_mask=None, causal=False):
+    """sequence_parallel.sp_self_attention body shim: per-shard keep-mask
+    -> additive bias (elementwise, so per-shard == global conversion)."""
+    key_bias = None if key_mask is None else mask_to_bias(key_mask)
+    return ring_attention(q, k, v, axis_name, key_bias=key_bias,
+                          causal=causal)
 
 
 def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -92,32 +95,12 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         sp_axis: str = "sp",
                         causal: bool = False) -> jax.Array:
     """shard_map wrapper: globally-shaped [B,H,L,D] in and out, with L
-    sharded over `sp_axis` and B over the data axes.
+    sharded over `sp_axis`, B over the data axes, heads over tp when
+    divisible (shared scaffolding: ops/sequence_parallel.py).
 
     mask: None, [B, L], or [B,1,1,L] key-padding mask (mask==0 masked)."""
-    B, H, L, D = q.shape
-    batch = _batch_axes(mesh)
-    lead = batch if len(batch) != 1 else batch[0]
-    # heads are embarrassingly parallel: split them over tp when present
-    head = ("tp" if "tp" in mesh.axis_names and mesh.shape["tp"] > 1
-            and H % mesh.shape["tp"] == 0 else None)
-    qkv_spec = P(lead, head, sp_axis, None)
-    bias_spec = P(lead, sp_axis)
+    from faster_distributed_training_tpu.ops.sequence_parallel import (
+        sp_self_attention)
 
-    key_bias = None
-    if mask is not None:
-        mask = jnp.asarray(mask)
-        if mask.ndim == 4:
-            mask = mask.reshape(B, mask.shape[-1])
-        key_bias = mask_to_bias(mask)
-
-    fn = partial(ring_attention, axis_name=sp_axis, causal=causal)
-    if key_bias is None:
-        return jax.shard_map(
-            lambda q_, k_, v_: fn(q_, k_, v_),
-            mesh=mesh, in_specs=(qkv_spec,) * 3,
-            out_specs=qkv_spec)(q, k, v)
-    return jax.shard_map(
-        lambda q_, k_, v_, b_: fn(q_, k_, v_, key_bias=b_),
-        mesh=mesh, in_specs=(qkv_spec,) * 3 + (bias_spec,),
-        out_specs=qkv_spec)(q, k, v, key_bias)
+    return sp_self_attention(_ring_body, q, k, v, mask, mesh,
+                             sp_axis=sp_axis, causal=causal)

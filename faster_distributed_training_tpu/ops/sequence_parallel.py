@@ -1,0 +1,67 @@
+"""Shared shard_map scaffolding for the sequence-parallel attention
+strategies (ring — ops/ring_attention.py, Ulysses — ops/ulysses_attention.py).
+
+One wrapper owns the mesh policy both strategies share, so it cannot
+drift between them:
+  * batch over the data axes (dp and/or fsdp),
+  * sequence over `sp_axis`,
+  * heads over `tp` when present and divisible — head-parallelism inside
+    sequence-parallelism,
+  * key-padding mask normalized to a [B, L] keep-mask sharded like the
+    sequence.
+
+The per-strategy `body` runs INSIDE shard_map on per-device shards with
+signature body(q, k, v, axis_name=..., key_mask=None, causal=False).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+
+
+def sp_self_attention(body: Callable, q: jax.Array, k: jax.Array,
+                      v: jax.Array, mask: Optional[jax.Array], mesh: Mesh,
+                      sp_axis: str = "sp", causal: bool = False,
+                      heads_per_shard_divisor: int = 1) -> jax.Array:
+    """Globally-shaped [B,H,L,D] in/out with L sharded over `sp_axis`,
+    B over the data axes, H over tp when divisible.
+
+    mask: None, [B, L], or [B,1,1,L] key-padding mask (mask==0 masked).
+    heads_per_shard_divisor: extra divisibility the strategy needs from
+    the per-device head count (Ulysses splits its local heads over sp
+    again, so it passes the sp size; the ring passes 1)."""
+    B, H, L, D = q.shape
+    batch = batch_axes(mesh)
+    lead = batch if len(batch) != 1 else batch[0]
+    tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
+    head = ("tp" if tp > 1 and H % tp == 0
+            and (H // tp) % heads_per_shard_divisor == 0 else None)
+    qkv_spec = P(lead, head, sp_axis, None)
+    mask_spec = P(lead, sp_axis)
+
+    key_mask = None
+    if mask is not None:
+        mask = jnp.asarray(mask)
+        if mask.ndim == 4:
+            mask = mask.reshape(B, mask.shape[-1])
+        key_mask = mask
+
+    fn = partial(body, axis_name=sp_axis, causal=causal)
+    if key_mask is None:
+        return jax.shard_map(
+            lambda q_, k_, v_: fn(q_, k_, v_),
+            mesh=mesh, in_specs=(qkv_spec,) * 3,
+            out_specs=qkv_spec)(q, k, v)
+    return jax.shard_map(
+        lambda q_, k_, v_, m_: fn(q_, k_, v_, key_mask=m_),
+        mesh=mesh, in_specs=(qkv_spec,) * 3 + (mask_spec,),
+        out_specs=qkv_spec)(q, k, v, key_mask)
