@@ -121,7 +121,7 @@ class MultiheadAttention(nn.Module):
       ring  — sequence-parallel ring attention over `sp_axis` of `mesh`
               (ops/ring_attention);
       ulysses — sequence-parallel all-to-all head/sequence swap over
-              `sp_axis` (ops/ulysses_attention; needs h %% sp == 0).
+              `sp_axis` (ops/ulysses_attention; needs h % sp == 0).
               flash/ring/ulysses never materialize the probability
               tensor, so attention-prob dropout is skipped there by
               construction.

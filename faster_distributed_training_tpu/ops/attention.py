@@ -80,14 +80,22 @@ def init_carry(q: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
     return m, l, acc
 
 
-@partial(jax.jit, static_argnames=("block_k",))
+@partial(jax.jit, static_argnames=("block_k", "causal"))
 def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         mask: Optional[jax.Array] = None,
-                        block_k: int = 128) -> jax.Array:
+                        block_k: int = 128,
+                        causal: bool = False) -> jax.Array:
     """Streaming attention over key blocks via lax.scan.
 
     q [B,H,Lq,D], k/v [B,H,Lk,D], mask broadcastable to [B,H,Lq,Lk]
     (mask==0 masked).  Numerically equal to dense softmax attention.
+
+    causal=True applies the lower-triangular constraint ANALYTICALLY per
+    key block (an [Lq, block_k] bias built inside the scan body from the
+    block's key positions) — never an [Lq, Lk] tensor, so long-context
+    callers (ops/ulysses_attention.py) stay O(L·block_k) in memory.
+    Assumes query position i attends key positions <= i with q/k indexed
+    from the same origin (Lq == Lk self-attention).
     """
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
@@ -113,13 +121,21 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         bias.reshape(B, bias.shape[1], bias.shape[2], n_blocks, block_k),
         3, 0)
 
+    q_pos = jnp.arange(Lq, dtype=jnp.int32)
+
     def body(carry, blk):
         m, l, acc = carry
-        k_blk, v_blk, bias_blk = blk
+        k_blk, v_blk, bias_blk, blk_idx = blk
+        if causal:
+            k_pos = blk_idx * block_k + jnp.arange(block_k, dtype=jnp.int32)
+            cb = jnp.where(k_pos[None, :] <= q_pos[:, None], 0.0, NEG_INF)
+            bias_blk = bias_blk + cb[None, None]       # [B,1,Lq,block_k]
         return online_block_update(q, k_blk, v_blk, bias_blk, m, l, acc,
                                    scale), None
 
-    (m, l, acc), _ = lax.scan(body, init_carry(q), (kb, vb, bb))
+    (m, l, acc), _ = lax.scan(
+        body, init_carry(q),
+        (kb, vb, bb, jnp.arange(n_blocks, dtype=jnp.int32)))
     return finalize(m, l, acc, q.dtype)
 
 

@@ -126,16 +126,32 @@ def _flash_bwd(block_q, res, g):
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _auto_block_q(lq: int, lk: int) -> int:
+    """Largest q-block in {1024..128} whose fp32 score tile (block_q x Lk)
+    stays within ~8 MB of VMEM — measured on v5e @ L=2048 D=64 bf16:
+    block_q=1024 runs ~20-25% faster than the 128 default (2.7-2.8 vs
+    3.4-3.9 ms), and the budget degrades the block gracefully as the
+    context grows (Lk=4096 -> 512, 8192 -> 256, 16384 -> 128)."""
+    budget = 8 * 1024 * 1024
+    for bq in (1024, 512, 256, 128):
+        if bq * lk * 4 <= budget:
+            return min(bq, max(lq, 128))
+    return 128
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     mask: Optional[jax.Array] = None,
-                    block_q: int = 128) -> jax.Array:
+                    block_q: Optional[int] = None) -> jax.Array:
     """Drop-in for dense_attention (models/transformer.py:101-111), minus
     attention-prob dropout (probabilities are never materialized).
 
     q/k/v: [B, H, L, D].  mask: None or a key-padding mask broadcastable
     to [B, 1, 1, Lk] (mask==0 masked) — full [B,H,Lq,Lk] masks should use
-    blockwise_attention directly.
+    blockwise_attention directly.  block_q: q-tile rows; None picks the
+    largest tile whose score buffer fits VMEM (_auto_block_q).
     """
+    if block_q is None:
+        block_q = _auto_block_q(q.shape[2], k.shape[2])
     key_bias = None
     if mask is not None:
         kb = jnp.asarray(mask)

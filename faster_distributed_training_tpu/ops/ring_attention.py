@@ -27,7 +27,7 @@ from jax import lax
 from jax.sharding import Mesh
 
 from faster_distributed_training_tpu.ops.attention import (
-    NEG_INF, finalize, mask_to_bias, online_block_update)
+    NEG_INF, finalize, init_carry, mask_to_bias, online_block_update)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -69,14 +69,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         b_cur = lax.ppermute(b_cur, axis_name, perm)
         return (k_cur, v_cur, b_cur, (src - 1) % sp, m, l, acc), None
 
-    # derive the fresh accumulators from q so they carry q's full
-    # varying-manual-axes set (dp AND sp), keeping scan carry types stable
-    # under shard_map's VMA checking regardless of the surrounding mesh
-    zero_rows = q[..., 0].astype(jnp.float32) * 0.0
-    m0 = zero_rows - jnp.inf
-    l0 = zero_rows
-    acc0 = q.astype(jnp.float32) * 0.0
-    carry0 = (k, v, key_bias.astype(jnp.float32) + zero_rows[:, 0, :] * 0.0,
+    # init_carry derives the accumulators from q, giving them q's full
+    # varying-manual-axes set (dp AND sp) so the scan carry types stay
+    # stable under shard_map's VMA checking
+    m0, l0, acc0 = init_carry(q)
+    # l0 is a q-derived zeros tensor; adding its [B, L] slice stamps q's
+    # VMA set onto the bias without changing its values
+    carry0 = (k, v, key_bias.astype(jnp.float32) + l0[:, 0, :],
               idx, m0, l0, acc0)
     (_, _, _, _, m, l, acc), _ = lax.scan(body, carry0, None, length=sp)
     return finalize(m, l, acc, q.dtype)

@@ -61,18 +61,16 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     mask4 = None
     if key_mask is not None:
-        # every device needs the mask for ALL keys once heads are split
+        # every device needs the mask for ALL keys once heads are split;
+        # stays [B,1,1,L] — the causal constraint is applied analytically
+        # per key block inside blockwise_attention, never as an [L,L] mask
         full = lax.all_gather(key_mask, axis_name, axis=1, tiled=True)
         mask4 = (full != 0)[:, None, None, :]                # [B,1,1,L]
-    if causal:
-        Lg = L_loc * sp
-        pos = jnp.arange(Lg, dtype=jnp.int32)
-        cm = (pos[None, :] <= pos[:, None])[None, None]      # [1,1,L,L]
-        mask4 = cm if mask4 is None else jnp.logical_and(mask4, cm)
 
     # full-length attention on H/sp heads; blockwise keeps memory O(L·blk)
     out = blockwise_attention(qh, kh, vh, mask=mask4,
-                              block_k=min(512, qh.shape[2]))
+                              block_k=min(512, qh.shape[2]),
+                              causal=causal)
 
     # head-sharded [B, H/sp, L, D] -> seq-sharded [B, H, L/sp, D]
     return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,

@@ -36,6 +36,19 @@ class TestBlockwise:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
 
+    def test_analytic_causal_matches_dense(self):
+        # causal=True builds per-key-block bias analytically — never an
+        # [Lq, Lk] mask tensor; must equal a dense lower-triangular mask,
+        # incl. with a block_k that does not divide L (padding interplay)
+        q, k, v = _qkv(jax.random.PRNGKey(40), L=24)
+        tri = jnp.tril(jnp.ones((24, 24), jnp.int32))[None, None]
+        ref = dense_attention_reference(q, k, v, tri)
+        for bk in (8, 7, 24):
+            out = blockwise_attention(q, k, v, block_k=bk, causal=True)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5,
+                                       err_msg=f"block_k={bk}")
+
     def test_matches_dense_with_padding_mask(self):
         q, k, v = _qkv(jax.random.PRNGKey(1))
         mask = _padding_mask(jax.random.PRNGKey(2))[:, None, None, :]
