@@ -71,10 +71,14 @@ def timed_run(use_ngd: bool, bs: int, steps: int) -> float:
             "label": rr.integers(0, 10, size=(bs,)).astype(np.int32),
         })
         step = jax.jit(make_train_step(cfg), donate_argnums=0)
-        # warmup / compile; fence with a device->host readback — on some
-        # PJRT backends block_until_ready returns at dispatch, not
-        # completion.
-        state, metrics = step(state, batch)
+        # Warmup: compile + advance past NGD's always-update phase (the
+        # Fisher refresh runs EVERY step while t < 10, then every 4th —
+        # optim/ngd.py NUM_INITIAL_ITERS), so the timed window measures the
+        # steady-state step, not the init transient.  Fence with a
+        # device->host readback — on some PJRT backends block_until_ready
+        # returns at dispatch, not completion.
+        for _ in range(12):
+            state, metrics = step(state, batch)
         float(metrics["loss"])
         t0 = time.monotonic()
         for _ in range(steps):
