@@ -34,9 +34,10 @@ import numpy as np
 BASELINE_REF_IPS = float(os.environ.get("FDT_BENCH_BASELINE", "0") or 0)
 
 
-def timed_run(use_ngd: bool, bs: int, steps: int) -> float:
+def timed_run(use_ngd: bool, bs: int, steps: int):
     """Build ONE donating train program (the Trainer's exact configuration)
-    and time `steps` executions, fenced by a device->host readback."""
+    and time `steps` executions, fenced by a device->host readback.
+    Returns (elapsed_seconds, compiled_peak_mem_bytes_or_None)."""
     import jax
     import jax.numpy as jnp
 
@@ -70,21 +71,29 @@ def timed_run(use_ngd: bool, bs: int, steps: int) -> float:
             "image": rr.normal(size=(bs, 32, 32, 3)).astype(np.float32),
             "label": rr.integers(0, 10, size=(bs,)).astype(np.int32),
         })
+        from faster_distributed_training_tpu.utils.profiling import (
+            compiled_memory_bytes)
+
+        # AOT-compile so the executable's memory analysis is available
+        # (the axon backend exposes no runtime memory_stats), then run the
+        # compiled object directly.
         step = jax.jit(make_train_step(cfg), donate_argnums=0)
-        # Warmup: compile + advance past NGD's always-update phase (the
-        # Fisher refresh runs EVERY step while t < 10, then every 4th —
+        compiled = step.lower(state, batch).compile()
+        mem = compiled_memory_bytes(compiled)
+        # Warmup: advance past NGD's always-update phase (the Fisher
+        # refresh runs EVERY step while t < 10, then every 4th —
         # optim/ngd.py NUM_INITIAL_ITERS), so the timed window measures the
         # steady-state step, not the init transient.  Fence with a
         # device->host readback — on some PJRT backends block_until_ready
         # returns at dispatch, not completion.
         for _ in range(12):
-            state, metrics = step(state, batch)
+            state, metrics = compiled(state, batch)
         float(metrics["loss"])
         t0 = time.monotonic()
         for _ in range(steps):
-            state, metrics = step(state, batch)
+            state, metrics = compiled(state, batch)
         float(metrics["loss"])
-        return time.monotonic() - t0
+        return time.monotonic() - t0, mem
 
 
 def main() -> None:
@@ -95,11 +104,11 @@ def main() -> None:
 
     if os.environ.get("FDT_BENCH_INTERNAL_SGD") == "1":
         # child process: print the SGD elapsed time and exit
-        print(json.dumps({"sgd_elapsed": timed_run(False, bs, steps)}))
+        print(json.dumps({"sgd_elapsed": timed_run(False, bs, steps)[0]}))
         return
 
     n_chips = jax.device_count()
-    elapsed = timed_run(True, bs, steps)
+    elapsed, mem = timed_run(True, bs, steps)
     ips_per_chip = bs * steps / elapsed / max(n_chips, 1)
     # vs_baseline: ratio against FDT_BENCH_BASELINE (img/s/chip) when set;
     # 1.0 otherwise = "no external baseline configured" — the absolute value
@@ -112,6 +121,8 @@ def main() -> None:
         "vs_baseline": round(vs, 3),
         "baseline_configured": bool(BASELINE_REF_IPS),
     }
+    if mem:
+        record["compiled_peak_mem_bytes"] = int(mem)
     if os.environ.get("FDT_BENCH_NGD_OVERHEAD") == "1":
         env = dict(os.environ, FDT_BENCH_INTERNAL_SGD="1")
         out = subprocess.run([sys.executable, os.path.abspath(__file__)],

@@ -24,9 +24,32 @@ def trace_profile(log_dir: Optional[str]) -> Iterator[None]:
 
 
 def peak_memory_bytes(device: Optional[jax.Device] = None) -> Optional[int]:
-    """Peak device memory if the backend exposes it (TPU does)."""
+    """Peak device memory if the backend exposes runtime stats (plain TPU
+    does; the axon tunnel and CPU do not and get None — the Trainer then
+    omits peak_mem from its epoch log; bench.py reports the static
+    compiled_memory_bytes estimate instead)."""
     device = device or jax.local_devices()[0]
     stats = getattr(device, "memory_stats", lambda: None)()
     if not stats:
         return None
     return stats.get("peak_bytes_in_use")
+
+
+def compiled_memory_bytes(compiled) -> Optional[int]:
+    """Static peak estimate from a compiled executable's memory analysis:
+    temp + argument + output − aliased (donated buffers are BOTH an
+    argument and an output — counting them twice would overstate a
+    donating train step by roughly the whole train state).  Available on
+    every backend, including ones without runtime memory_stats."""
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return None
+        total = 0
+        for field in ("temp_size_in_bytes", "argument_size_in_bytes",
+                      "output_size_in_bytes"):
+            total += int(getattr(ma, field, 0) or 0)
+        total -= int(getattr(ma, "alias_size_in_bytes", 0) or 0)
+        return total if total > 0 else None
+    except Exception:
+        return None
