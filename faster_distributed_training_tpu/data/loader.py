@@ -182,13 +182,20 @@ class PrefetchIterator:
 
         self._t = threading.Thread(target=worker, daemon=True)
         self._t.start()
+        self._done = False
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        if self._done:
+            # the worker is gone and the queue is empty — a second get()
+            # would block forever (unlike a generator, which raises
+            # StopIteration on every call after exhaustion)
+            raise StopIteration
         item = self._q.get()
         if item is self._DONE:
+            self._done = True
             if self._err is not None:
                 raise self._err
             raise StopIteration
@@ -202,17 +209,20 @@ def device_prefetch(iterator: Iterable, put_fn: Callable[[Any], Any],
     (resnet50_test.py:522)."""
     staged = []
     it = iter(iterator)
+    exhausted = False
     try:
         for _ in range(depth):
             staged.append(put_fn(next(it)))
     except StopIteration:
-        pass
+        exhausted = True
     while staged:
-        nxt = None
-        try:
-            nxt = put_fn(next(it))
-        except StopIteration:
-            pass
+        if not exhausted:
+            # stage the NEXT batch before yielding the current one so its
+            # transfer overlaps the consumer's compute; once exhausted,
+            # never call next() again — not every iterator keeps raising
+            # StopIteration (PrefetchIterator's queue would block)
+            try:
+                staged.append(put_fn(next(it)))
+            except StopIteration:
+                exhausted = True
         yield staged.pop(0)
-        if nxt is not None:
-            staged.append(nxt)

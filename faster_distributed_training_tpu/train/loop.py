@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 import jax
 
 from faster_distributed_training_tpu.config import TrainConfig
+from faster_distributed_training_tpu.data.loader import device_prefetch
 from faster_distributed_training_tpu.train import checkpoint as ckpt
 from faster_distributed_training_tpu.train.metrics import MetricAccumulator
 from faster_distributed_training_tpu.train.state import TrainState
@@ -61,8 +62,12 @@ class Trainer:
         acc = MetricAccumulator()
         t0 = time.monotonic()
         metrics = None
-        for batch in loader:
-            state, metrics = self.train_step(state, self.put_batch(batch))
+        # device_prefetch stages put_batch (H2D transfer + device-side
+        # augmentation dispatch) ahead of the consuming step — the
+        # pin_memory + non_blocking overlap (resnet50_test.py:522), TPU style
+        for batch in device_prefetch(loader, self.put_batch,
+                                     depth=self.cfg.prefetch_depth):
+            state, metrics = self.train_step(state, batch)
             acc.add(metrics)
         if metrics is not None:
             # fence with a device->host readback: on some PJRT backends
@@ -76,8 +81,9 @@ class Trainer:
 
     def evaluate(self, state: TrainState, loader: Iterable) -> Dict[str, float]:
         acc = MetricAccumulator()
-        for batch in loader:
-            acc.add(self.eval_step(state, self.put_eval_batch(batch)))
+        for batch in device_prefetch(loader, self.put_eval_batch,
+                                     depth=self.cfg.prefetch_depth):
+            acc.add(self.eval_step(state, batch))
         return acc.summary()
 
     def fit(self, state: TrainState, train_loader: LoaderFn,

@@ -244,3 +244,27 @@ class TestShardConsistency:
         with pytest.raises(AssertionError, match="identical"):
             _check_shard_digests(np.asarray(
                 [digest(100, 1, 1, 3, 0), digest(100, 1, 1, 3, 0)]))
+
+
+def test_prefetch_iterator_exhaustion_is_idempotent():
+    """A drained PrefetchIterator must keep raising StopIteration —
+    a second next() used to block forever on the empty queue, deadlocking
+    device_prefetch (which drains its staged batches after the source
+    ends)."""
+    from faster_distributed_training_tpu.data import PrefetchIterator
+    from faster_distributed_training_tpu.data.loader import device_prefetch
+
+    it = PrefetchIterator(iter(range(3)), depth=2)
+    assert list(it) == [0, 1, 2]
+    for _ in range(3):           # must not block, must not yield
+        try:
+            next(it)
+            raise AssertionError("expected StopIteration")
+        except StopIteration:
+            pass
+
+    # composed: device_prefetch over a PrefetchIterator terminates and
+    # yields everything exactly once
+    out = list(device_prefetch(PrefetchIterator(iter(range(5)), depth=2),
+                               lambda x: x * 10, depth=2))
+    assert out == [0, 10, 20, 30, 40]
