@@ -247,7 +247,7 @@ def run_training(cfg: TrainConfig,
     from faster_distributed_training_tpu.parallel import (
         initialize_distributed, make_mesh)
     from faster_distributed_training_tpu.parallel.placement import (
-        dp_size, make_put_batch, shard_train_state)
+        dp_size, make_put_batch, shard_train_state, train_state_shardings)
     from faster_distributed_training_tpu.train import (Trainer,
                                                        create_train_state,
                                                        init_meta_lambda)
@@ -284,7 +284,9 @@ def run_training(cfg: TrainConfig,
     state = create_train_state(model, tx, sample, rng,
                                init_kwargs={"train": True},
                                extra_params=extra)
-    state = shard_train_state(state, mesh, cfg)
+    shardings = (train_state_shardings(state, mesh, cfg)
+                 if cfg.host_offload else None)
+    state = shard_train_state(state, mesh, cfg, shardings=shardings)
 
     # device-side augmentation folded into batch staging (train only);
     # the key advances per put so every batch sees fresh augmentation.
@@ -310,7 +312,8 @@ def run_training(cfg: TrainConfig,
     ckpt_name = "transformer" if is_text else "resnet"
     with mesh:
         trainer = Trainer(cfg, put_batch=put_train,
-                          put_eval_batch=put_eval, log=log)
+                          put_eval_batch=put_eval, log=log,
+                          state_shardings=shardings)
         state, start_epoch = trainer.maybe_resume(state, ckpt_name)
         with trace_profile("./profile" if cfg.profile else None):
             state = trainer.fit(state, train_loader, eval_loader,

@@ -99,10 +99,12 @@ def _supports_memory_kind(mesh: Mesh) -> bool:
         return False
 
 
-def shard_train_state(state, mesh: Mesh, cfg: TrainConfig):
+def shard_train_state(state, mesh: Mesh, cfg: TrainConfig, shardings=None):
     """device_put the full state per the DP/FSDP/offload policy.  Offload
-    applies only to params/opt_state (the big leaves)."""
-    shardings = train_state_shardings(state, mesh, cfg)
+    applies only to params/opt_state (the big leaves).  Pass `shardings`
+    (from train_state_shardings) to reuse an already-computed tree."""
+    if shardings is None:
+        shardings = train_state_shardings(state, mesh, cfg)
     return jax.tree.map(jax.device_put, state, shardings)
 
 

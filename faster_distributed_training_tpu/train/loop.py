@@ -42,7 +42,8 @@ class Trainer:
 
     def __init__(self, cfg: TrainConfig, put_batch: Optional[Callable] = None,
                  put_eval_batch: Optional[Callable] = None,
-                 log: Callable[[str], None] = print):
+                 log: Callable[[str], None] = print,
+                 state_shardings=None):
         self.cfg = cfg
         self.put_batch = put_batch or (lambda b: b)
         # eval staging may differ (e.g. normalize-only augmentation);
@@ -50,7 +51,13 @@ class Trainer:
         self.put_eval_batch = put_eval_batch or self.put_batch
         self.log = log if jax.process_index() == 0 else (lambda *_: None)
         donate = {"donate_argnums": 0} if getattr(cfg, "donate", True) else {}
-        self.train_step = jax.jit(make_train_step(cfg), **donate)
+        # state_shardings is only needed for --host_offload (the train step
+        # fetch/stashes the state across memory kinds per batch,
+        # steps._offload_transfers; evaluate() fetches once per epoch)
+        self._offload_shardings = (state_shardings if cfg.host_offload
+                                   else None)
+        self.train_step = jax.jit(make_train_step(cfg, state_shardings),
+                                  **donate)
         self.eval_step = jax.jit(make_eval_step(cfg))
         self.history: Dict[str, List[float]] = {
             "train_acc": [], "test_acc": [], "train_loss": [],
@@ -80,6 +87,12 @@ class Trainer:
         return state, acc.summary(), elapsed
 
     def evaluate(self, state: TrainState, loader: Iterable) -> Dict[str, float]:
+        if self._offload_shardings is not None:
+            # one host->device transfer per eval epoch (state is constant
+            # across eval batches) instead of an in-graph fetch per batch
+            to_dev = jax.tree.map(lambda sh: sh.with_memory_kind("device"),
+                                  self._offload_shardings)
+            state = jax.tree.map(jax.device_put, state, to_dev)
         acc = MetricAccumulator()
         for batch in device_prefetch(loader, self.put_eval_batch,
                                      depth=self.cfg.prefetch_depth):

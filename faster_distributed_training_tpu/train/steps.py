@@ -45,15 +45,51 @@ def _tree_where(pred, a, b):
     return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
 
 
-def make_train_step(cfg: TrainConfig) -> Callable[[TrainState, Any],
-                                                  Tuple[TrainState, Metrics]]:
-    """Build the jitted train step for cfg.model ('resnet*' or 'transformer')."""
+def _offload_transfers(state_shardings):
+    """(fetch, stash) for --host_offload: params/optimizer state live in
+    pinned_host between steps (CPUOffload(offload_params=True) analog,
+    transformer_test.py:46-48); XLA cannot compute on host-placed operands
+    directly, so the step fetches the state into device memory on entry
+    and stashes the update back to host before returning — both transfers
+    are in-graph (jax.device_put under jit), so XLA schedules/overlaps
+    them."""
+    if state_shardings is None:
+        return (lambda s: s), (lambda s: s)
+    to_dev = jax.tree.map(lambda sh: sh.with_memory_kind("device"),
+                          state_shardings)
+
+    def fetch(state):
+        return jax.tree.map(jax.device_put, state, to_dev)
+
+    def stash(state):
+        return jax.tree.map(jax.device_put, state, state_shardings)
+
+    return fetch, stash
+
+
+def make_train_step(cfg: TrainConfig, state_shardings=None
+                    ) -> Callable[[TrainState, Any],
+                                  Tuple[TrainState, Metrics]]:
+    """Build the jitted train step for cfg.model ('resnet*' or 'transformer').
+
+    state_shardings: pass the TrainState-shaped sharding tree when
+    cfg.host_offload is on — the step then round-trips the state
+    host->device->host per _offload_transfers."""
     fp16 = cfg.precision == "fp16"
     is_text = cfg.model == "transformer"
     mode = resolve_mixup_mode(cfg)
+    if cfg.host_offload and state_shardings is None:
+        # the placement layer pins params/opt state to pinned_host for this
+        # cfg; a step without the fetch would compile against host-placed
+        # operands (TPU: compile error; worse, a silent contract violation)
+        raise ValueError("cfg.host_offload=True requires state_shardings "
+                         "(see parallel.placement.train_state_shardings)")
+    fetch, stash = _offload_transfers(
+        state_shardings if cfg.host_offload else None)
 
     def step(state: TrainState, batch: Dict[str, jax.Array]
              ) -> Tuple[TrainState, Metrics]:
+        state = fetch(state)
         step_key = jax.random.fold_in(state.rng, state.step)
         k_mix, k_drop = jax.random.split(step_key)
         y = batch["label"]
@@ -130,14 +166,19 @@ def make_train_step(cfg: TrainConfig) -> Callable[[TrainState, Any],
                    "total": jnp.asarray(y.shape[0], jnp.float32)}
         if fp16:
             metrics["loss_scale"] = updated.loss_scale.scale
-        return updated, metrics
+        return stash(updated), metrics
 
     return step
 
 
-def make_eval_step(cfg: TrainConfig) -> Callable[[TrainState, Any], Metrics]:
+def make_eval_step(cfg: TrainConfig) -> Callable[[TrainState, Any],
+                                                 Metrics]:
     """Eval: deterministic forward (running BN stats, no dropout, no mixup —
-    fixing the reference's always-on eval mixup, transformer_test.py:321)."""
+    fixing the reference's always-on eval mixup, transformer_test.py:321).
+
+    No offload fetch here: under --host_offload the Trainer transfers the
+    state to device ONCE per eval epoch (Trainer.evaluate), not per batch —
+    the state never changes inside an eval loop."""
     is_text = cfg.model == "transformer"
 
     def step(state: TrainState, batch: Dict[str, jax.Array]) -> Metrics:
