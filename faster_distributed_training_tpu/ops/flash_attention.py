@@ -8,8 +8,11 @@ TPU-first replacement for the reference's dense ScaledDotProduct
     one MXU matmul for the context.  Probabilities never touch HBM.
   * backward — recompute-in-backward (the same memory trick as the
     reference's FusedConvBN, resnet.py:107-108): residuals are just
-    (q, k, v, mask); gradients come from the VJP of the blockwise
-    implementation, so peak memory stays O(L·block) in both passes.
+    (q, k, v, mask).  The VJP formulation is a measured two-branch
+    policy (_flash_bwd): dense when ~3 score-shaped fp32 transients fit
+    the budget (v5e, 6L d512 bs=64 L=512: full step 95 ms vs 163 ms
+    with the blockwise VJP), blockwise beyond it so long-context peak
+    memory stays O(L·block).
   * non-TPU backends (tests, CPU sim) use the blockwise path; set
     FDT_FORCE_PALLAS_INTERPRET=1 to exercise the kernel in interpreter
     mode on CPU.
@@ -31,7 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from faster_distributed_training_tpu.ops.attention import (
-    NEG_INF, blockwise_attention, mask_to_bias)
+    NEG_INF, blockwise_attention, dense_attention_reference, mask_to_bias)
 
 
 def _use_pallas() -> bool:
@@ -111,14 +114,33 @@ def _flash_fwd(q, k, v, key_bias, block_q):
     return _flash_core(q, k, v, key_bias, block_q), (q, k, v, key_bias)
 
 
+# Backward-policy budget for the DENSE-VJP branch.  The dense backward
+# holds ~3 score-shaped fp32 tensors at peak (the saved probabilities
+# residual plus the ds/dp transients), so the comparison below multiplies
+# scores_bytes by 3.  Measured on v5e (6L d512 transformer, bs=64, L=512):
+# full step 95 ms dense-bwd vs 163 ms blockwise-bwd; the blockwise VJP's
+# scan recompute only pays off once sequences outgrow this budget.
+_DENSE_BWD_BUDGET_BYTES = 2 << 30
+
+
 def _flash_bwd(block_q, res, g):
     q, k, v, key_bias = res
     mask = None
     if key_bias is not None:
         mask = (key_bias > NEG_INF / 2).astype(jnp.int32)[:, None, None, :]
-    # recompute-in-backward: differentiate the blockwise formulation
-    _, vjp = jax.vjp(lambda q_, k_, v_: blockwise_attention(q_, k_, v_, mask),
-                     q, k, v)
+    B, H, Lq, _ = q.shape
+    Lk = k.shape[2]
+    scores_bytes = 4 * B * H * Lq * Lk
+    if 3 * scores_bytes <= _DENSE_BWD_BUDGET_BYTES:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: dense_attention_reference(q_, k_, v_, mask),
+            q, k, v)
+    else:
+        # long context: recompute-in-backward via the blockwise formulation
+        # keeps peak memory O(L*block) at the price of the scan recompute
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: blockwise_attention(q_, k_, v_, mask),
+            q, k, v)
     dq, dk, dv = vjp(g)
     return dq, dk, dv, None
 

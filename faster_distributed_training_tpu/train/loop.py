@@ -89,10 +89,18 @@ class Trainer:
     def evaluate(self, state: TrainState, loader: Iterable) -> Dict[str, float]:
         if self._offload_shardings is not None:
             # one host->device transfer per eval epoch (state is constant
-            # across eval batches) instead of an in-graph fetch per batch
-            to_dev = jax.tree.map(lambda sh: sh.with_memory_kind("device"),
-                                  self._offload_shardings)
-            state = jax.tree.map(jax.device_put, state, to_dev)
+            # across eval batches) instead of an in-graph fetch per batch —
+            # and ONLY of the leaves eval reads (params + batch_stats);
+            # opt_state stays on pinned_host, which is the point of offload
+            dev = lambda sh: sh.with_memory_kind("device")  # noqa: E731
+            state = state.replace(
+                params=jax.tree.map(
+                    lambda x, sh: jax.device_put(x, dev(sh)),
+                    state.params, self._offload_shardings.params),
+                batch_stats=jax.tree.map(
+                    lambda x, sh: jax.device_put(x, dev(sh)),
+                    state.batch_stats,
+                    self._offload_shardings.batch_stats))
         acc = MetricAccumulator()
         for batch in device_prefetch(loader, self.put_eval_batch,
                                      depth=self.cfg.prefetch_depth):
