@@ -121,6 +121,12 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--workers", default=d.workers, type=int, help="data loader workers")
     p.add_argument("--meta_learning", action="store_true",
                    help="learnable per-sample mixup lambda")
+    p.add_argument("--mixup_mode", default=d.mixup_mode,
+                   choices=["", "static", "intra", "meta", "attn", "none"],
+                   help="mixup variant ('' auto: meta when --meta_learning, "
+                        "static when alpha != 0, else none; attn = learnable "
+                        "per-pixel map, resnet50_test.py:404-424; intra = "
+                        "same-class-only static)")
     p.add_argument("--distributed", action="store_true", help="multi-host run")
     p.add_argument("--ngd", action="store_true", help="natural gradient descent")
     p.add_argument("--weight_decay", default=d.weight_decay, type=float)
@@ -191,7 +197,8 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
     axes, shape = parse_mesh(args.mesh)
     cfg = base.replace(
         lr=args.lr, resume=args.resume, epochs=args.epoch, alpha=args.alpha,
-        batch_size=args.bs, workers=args.workers, meta_learning=args.meta_learning,
+        batch_size=args.bs, workers=args.workers,
+        meta_learning=args.meta_learning, mixup_mode=args.mixup_mode,
         distributed=args.distributed, use_ngd=args.ngd,
         weight_decay=args.weight_decay, gamma=args.gamma,
         optimizer=args.optimizer, device=args.device, precision=args.precision,

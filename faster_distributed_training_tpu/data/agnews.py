@@ -6,10 +6,12 @@ Re-expression of the reference's AG_NEWS_DATASET + collate
 reference; a built-in English list here — gensim is not a dependency),
 then tokenization.
 
-Tokenizer: HuggingFace ``bert-base-uncased`` when available locally
-(the reference downloads it, transformer_test.py:96); otherwise a
-deterministic hash-bucket word tokenizer so the pipeline works in
-zero-egress environments.  Labels arrive 1-indexed in the CSV and are
+Tokenizer: HuggingFace ``bert-base-uncased`` when cached locally (the
+reference downloads it, transformer_test.py:96); otherwise our own
+WordPiece (data/wordpiece.py — HF-algorithm-parity-tested) over a real
+``vocab.txt`` if one exists on disk, else over a deterministic
+corpus-trained vocab; the crc32 hash tokenizer remains only as the
+no-corpus last resort.  Labels arrive 1-indexed in the CSV and are
 shifted to 0-based (transformer_test.py:242).
 
 TPU-critical change: the reference pads each batch to its longest
@@ -93,6 +95,53 @@ def _load_hf_tokenizer():
         return None
 
 
+# corpus-trained tokenizers memoized per data_dir: the TRAIN split builds
+# the vocab, and the TEST split in the same process must reuse the same
+# object even when the on-disk cache can't be written (read-only
+# data_dir) — otherwise train and eval ids silently disagree
+_corpus_tokenizers: Dict[str, object] = {}
+
+
+def _resolve_tokenizer(data_dir: str, corpus_texts: Sequence[str]):
+    """Tokenizer priority (transformer_test.py:96 wants bert-base-uncased):
+      1. the HF tokenizer itself, when cached locally;
+      2. our WordPiece over a real bert vocab.txt found on disk — same
+         token ids as HF (algorithm parity: tests/test_wordpiece.py);
+      3. our WordPiece over a deterministic corpus-trained vocab, cached
+         beside the dataset (and memoized in-process) so train/test
+         share one vocab (zero-egress);
+      4. crc32 HashTokenizer (no corpus and no vocab — last resort).
+    """
+    from faster_distributed_training_tpu.data.wordpiece import (
+        WordPieceTokenizer, build_wordpiece_vocab, find_bert_vocab)
+
+    hf = _load_hf_tokenizer()
+    if hf is not None:
+        return hf
+    vocab_path = find_bert_vocab(data_dir)
+    if vocab_path:
+        return WordPieceTokenizer.from_vocab_file(vocab_path)
+    cache = os.path.join(data_dir, "ag_news", "wordpiece_vocab.txt")
+    if os.path.isfile(cache):
+        return WordPieceTokenizer.from_vocab_file(cache)
+    memo = _corpus_tokenizers.get(os.path.abspath(data_dir))
+    if memo is not None:
+        return memo
+    if corpus_texts:
+        tk = WordPieceTokenizer(build_wordpiece_vocab(corpus_texts))
+        _corpus_tokenizers[os.path.abspath(data_dir)] = tk
+        try:
+            os.makedirs(os.path.dirname(cache), exist_ok=True)
+            tk.save_vocab(cache)
+        except OSError:
+            print(f"[data] warning: could not write {cache}; later "
+                  f"processes will rebuild the vocab from their own "
+                  f"split — keep data_dir writable for cross-process "
+                  f"train/eval vocab agreement")
+        return tk
+    return HashTokenizer()
+
+
 def bucket_length(n: int, buckets: Sequence[int]) -> int:
     """Smallest bucket >= n (last bucket truncates)."""
     for b in buckets:
@@ -110,9 +159,6 @@ class AGNewsDataset:
         path = os.path.join(data_dir, "ag_news",
                             "train.csv" if train else "test.csv")
         self.buckets = tuple(buckets)
-        self.tokenizer = tokenizer
-        if self.tokenizer is None:
-            self.tokenizer = _load_hf_tokenizer() or HashTokenizer()
         self.samples: List[Tuple[str, int]] = []
         if os.path.exists(path):
             with open(path, newline="", encoding="utf-8") as f:
@@ -126,6 +172,28 @@ class AGNewsDataset:
             raise FileNotFoundError(
                 f"AG News CSV not found at {path}; use data.synthetic."
                 f"synthetic_agnews for offline runs")
+        self.tokenizer = tokenizer
+        if self.tokenizer is None:
+            self.tokenizer = _resolve_tokenizer(
+                data_dir, [t for t, _ in self.samples])
+
+    @classmethod
+    def from_samples(cls, samples: Sequence[Tuple[str, int]],
+                     buckets: Sequence[int] = (64, 128, 256, 512),
+                     tokenizer=None, data_dir: str = "",
+                     clean: bool = True) -> "AGNewsDataset":
+        """Build a dataset from in-memory (text, label) pairs — the same
+        pipeline (clean -> tokenize -> bucket) without a CSV on disk;
+        used by tests and the input-pipeline benchmark."""
+        self = cls.__new__(cls)
+        self.buckets = tuple(buckets)
+        self.samples = [((clean_text(t) if clean else t), int(l))
+                        for t, l in samples]
+        self.tokenizer = tokenizer
+        if self.tokenizer is None:
+            self.tokenizer = _resolve_tokenizer(
+                data_dir or ".", [t for t, _ in self.samples])
+        return self
 
     def __len__(self) -> int:
         return len(self.samples)
@@ -142,6 +210,30 @@ class AGNewsDataset:
         """Tokenize + pad to the bucketed length (static shapes)."""
         texts = [self.samples[i][0] for i in indices]
         labels = np.asarray([self.samples[i][1] for i in indices], np.int32)
+        from faster_distributed_training_tpu.data.wordpiece import (
+            WordPieceTokenizer)
+        if isinstance(self.tokenizer, WordPieceTokenizer):
+            tk = self.tokenizer
+            handle = tk.native_handle()
+            native = None
+            if handle is not None:
+                from faster_distributed_training_tpu.runtime import native_lib
+                native = native_lib.wp_encode_batch(
+                    handle, texts, max_len, tk.cls_id, tk.sep_id,
+                    tk.unk_id, tk.pad_token_id)
+            if native is not None:
+                tokens_full, lens = native
+                L = bucket_length(int(lens.max()),
+                                  [b for b in self.buckets if b <= max_len]
+                                  or [max_len])
+                tokens = tokens_full[:, :L]
+                mask = (np.arange(L)[None, :] < lens[:, None]).astype(np.int32)
+                return {"tokens": tokens,
+                        "token_types": np.zeros_like(tokens),
+                        "mask": mask, "label": labels}
+            # non-ASCII text or no native lib: the generic Python path
+            # below handles it (WordPieceTokenizer has the HF encode
+            # signature)
         if isinstance(self.tokenizer, HashTokenizer):
             from faster_distributed_training_tpu.runtime import native_lib
             tk = self.tokenizer

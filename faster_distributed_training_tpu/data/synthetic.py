@@ -13,7 +13,8 @@ from typing import Dict, Sequence, Tuple
 import numpy as np
 
 
-def synthetic_cifar(n: int = 1024, seed: int = 0, num_classes: int = 10
+def synthetic_cifar(n: int = 1024, seed: int = 0, num_classes: int = 10,
+                    signal: float = 0.6, noise_std: float = 40.0
                     ) -> Tuple[np.ndarray, np.ndarray]:
     """(NHWC uint8 images, int32 labels) with learnable class structure:
     class k images are noise biased by a per-class mean pattern.
@@ -22,13 +23,20 @@ def synthetic_cifar(n: int = 1024, seed: int = 0, num_classes: int = 10
     only varies labels/noise.  Different splits (train seed 0, test seed
     1) therefore share the class structure, so generalization is
     measurable; deriving prototypes from `seed` would give every split
-    its own classes and pin test accuracy at chance."""
+    its own classes and pin test accuracy at chance.
+
+    signal/noise_std tune difficulty: the defaults make an easy task
+    (tests overfit it in a few steps); the accuracy-evidence convergence
+    runs lower the signal so the learning curve has a real shape instead
+    of saturating in epoch 1 (FDT_SYNTH_SIGNAL/FDT_SYNTH_NOISE env
+    overrides, read by cli.load_dataset)."""
     rng = np.random.default_rng(seed)
     proto_rng = np.random.default_rng(20260101)
     labels = rng.integers(0, num_classes, size=n).astype(np.int32)
     prototypes = proto_rng.integers(0, 256, size=(num_classes, 32, 32, 3))
-    noise = rng.normal(0, 40, size=(n, 32, 32, 3))
-    x = np.clip(prototypes[labels] * 0.6 + noise + 50, 0, 255).astype(np.uint8)
+    noise = rng.normal(0, noise_std, size=(n, 32, 32, 3))
+    x = np.clip(prototypes[labels] * signal + noise + 50,
+                0, 255).astype(np.uint8)
     return x, labels
 
 

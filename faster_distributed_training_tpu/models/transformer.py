@@ -122,9 +122,11 @@ class MultiheadAttention(nn.Module):
               (ops/ring_attention);
       ulysses — sequence-parallel all-to-all head/sequence swap over
               `sp_axis` (ops/ulysses_attention; needs h % sp == 0).
-              flash/ring/ulysses never materialize the probability
-              tensor, so attention-prob dropout is skipped there by
-              construction.
+    EVERY impl applies attention-prob dropout in training
+    (transformer.py:190-192): dense uses jax.random.bernoulli on the
+    materialized probabilities; flash/ring/ulysses use the stateless
+    index-hash dropout (ops.attention.dropout_keep) computed inside the
+    kernel/scan, so the probability tensor still never touches HBM.
     """
     h: int
     d_model: int
@@ -146,10 +148,19 @@ class MultiheadAttention(nn.Module):
         q = dense("query")(x).reshape(B, L, self.h, d_k).transpose(0, 2, 1, 3)
         k = dense("key")(x).reshape(B, L, self.h, d_k).transpose(0, 2, 1, 3)
         v = dense("value")(x).reshape(B, L, self.h, d_k).transpose(0, 2, 1, 3)
+        # training-path prob dropout for the never-materialized impls:
+        # one fresh u32 hash seed per step from the dropout rng stream
+        drop_rate = self.dropout if (self.dropout > 0 and train) else 0.0
+        drop_seed = (jax.random.bits(self.make_rng("dropout"),
+                                     dtype=jnp.uint32)
+                     if drop_rate > 0 and self.attention_impl != "dense"
+                     else None)
         if self.attention_impl == "flash":
             from faster_distributed_training_tpu.ops.flash_attention import (
                 flash_attention)
-            ctx = flash_attention(q, k, v, mask=mask)
+            ctx = flash_attention(q, k, v, mask=mask,
+                                  dropout_rate=drop_rate,
+                                  dropout_seed=drop_seed)
         elif self.attention_impl in ("ring", "ulysses"):
             if self.mesh is None:
                 raise ValueError(
@@ -162,7 +173,9 @@ class MultiheadAttention(nn.Module):
                 from faster_distributed_training_tpu.ops.ulysses_attention import (
                     ulysses_self_attention as sp_attention)
             ctx = sp_attention(q, k, v, mask, self.mesh,
-                               sp_axis=self.sp_axis)
+                               sp_axis=self.sp_axis,
+                               dropout_rate=drop_rate,
+                               dropout_seed=drop_seed)
         else:
             rng = (self.make_rng("dropout")
                    if (self.dropout > 0 and train) else None)

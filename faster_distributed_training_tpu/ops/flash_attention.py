@@ -6,16 +6,20 @@ TPU-first replacement for the reference's dense ScaledDotProduct
   * forward — a Pallas kernel tiled (batch·head, query-block) with K/V
     resident in VMEM: one MXU matmul for scores, row-softmax in fp32,
     one MXU matmul for the context.  Probabilities never touch HBM.
+    Attention-prob dropout (training) is an in-kernel index-hash mask
+    (ops.attention.dropout_keep) — still no HBM probabilities.
   * backward — recompute-in-backward (the same memory trick as the
     reference's FusedConvBN, resnet.py:107-108): residuals are just
-    (q, k, v, mask).  The VJP formulation is a measured two-branch
-    policy (_flash_bwd): dense when ~3 score-shaped fp32 transients fit
-    the budget (v5e, 6L d512 bs=64 L=512: full step 95 ms vs 163 ms
-    with the blockwise VJP), blockwise beyond it so long-context peak
-    memory stays O(L·block).
+    (q, k, v, mask, seed).  Three measured branches (_flash_bwd):
+    dense VJP when ~3 score-shaped fp32 transients fit the budget
+    (v5e, 6L d512 bs=64 L=512: full step 95 ms vs 163 ms blockwise);
+    beyond it, a Pallas backward KERNEL on TPU (softmax stats
+    recomputed per q-block, dk/dv accumulated across the sequential
+    grid — O(L·block) memory, kill-switch FDT_DISABLE_PALLAS_BWD=1);
+    the blockwise-scan VJP elsewhere.
   * non-TPU backends (tests, CPU sim) use the blockwise path; set
-    FDT_FORCE_PALLAS_INTERPRET=1 to exercise the kernel in interpreter
-    mode on CPU.
+    FDT_FORCE_PALLAS_INTERPRET=1 to exercise both kernels in
+    interpreter mode on CPU.
 
 Per-head K/V for supported workloads fits VMEM comfortably (e.g.
 L=512, D=64, fp32 → 128 KiB per tensor of the ~16 MiB budget); longer
@@ -45,10 +49,17 @@ def _use_pallas() -> bool:
 
 def _flash_fwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
                       key_bias: Optional[jax.Array],
-                      block_q: int) -> jax.Array:
-    """q/k/v [N, L, D] (N = B·H), key_bias [N, Lk] additive or None."""
+                      block_q: int, dropout_rate: float = 0.0,
+                      dropout_seed: Optional[jax.Array] = None) -> jax.Array:
+    """q/k/v [N, L, D] (N = B·H), key_bias [N, Lk] additive or None.
+
+    dropout_rate > 0 applies ops.attention.dropout_keep in-kernel: the
+    keep mask is a pure hash of (seed, n, global q row, k col), so the
+    recompute backward regenerates it exactly without any HBM mask."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    from faster_distributed_training_tpu.ops.attention import dropout_keep
 
     N, Lq, D = q.shape
     Lk = k.shape[1]
@@ -61,8 +72,10 @@ def _flash_fwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
     if key_bias is None:
         key_bias = jnp.zeros((N, Lk), jnp.float32)
     key_bias = key_bias.reshape(N, 1, Lk).astype(jnp.float32)
+    seed = (dropout_seed if dropout_seed is not None
+            else jnp.uint32(0)).reshape(1, 1).astype(jnp.uint32)
 
-    def kernel(q_ref, k_ref, v_ref, b_ref, o_ref):
+    def kernel(q_ref, k_ref, v_ref, b_ref, s_ref, o_ref):
         qb = q_ref[0]                                   # [block_q, D]
         s = jax.lax.dot_general(
             qb, k_ref[0], (((1,), (1,)), ((), ())),
@@ -71,6 +84,12 @@ def _flash_fwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
         m = jnp.max(s, axis=-1, keepdims=True)
         p = jnp.exp(s - m)
         l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+        if dropout_rate > 0.0:
+            n = pl.program_id(0)
+            qrow = (pl.program_id(1) * block_q
+                    + jax.lax.broadcasted_iota(jnp.int32, (block_q, Lk), 0))
+            kcol = jax.lax.broadcasted_iota(jnp.int32, (block_q, Lk), 1)
+            p = p * dropout_keep(s_ref[0, 0], n, qrow, kcol, dropout_rate)
         ctx = jnp.dot(p.astype(v_ref.dtype), v_ref[0],
                       preferred_element_type=jnp.float32)
         o_ref[0] = (ctx / l).astype(o_ref.dtype)
@@ -83,35 +102,41 @@ def _flash_fwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
             pl.BlockSpec((1, Lk, D), lambda n, i: (n, 0, 0)),
             pl.BlockSpec((1, Lk, D), lambda n, i: (n, 0, 0)),
             pl.BlockSpec((1, 1, Lk), lambda n, i: (n, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda n, i: (n, i, 0)),
         out_shape=jax.ShapeDtypeStruct((N, nq * block_q, D), q.dtype),
         interpret=(jax.default_backend() != "tpu"),
-    )(q, k, v, key_bias)
+    )(q, k, v, key_bias, seed)
     return out[:, :Lq, :]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _flash_core(q, k, v, key_bias, block_q):
-    return _flash_impl(q, k, v, key_bias, block_q)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash_core(q, k, v, key_bias, dropout_seed, block_q, dropout_rate):
+    return _flash_impl(q, k, v, key_bias, dropout_seed, block_q,
+                       dropout_rate)
 
 
-def _flash_impl(q, k, v, key_bias, block_q):
+def _flash_impl(q, k, v, key_bias, dropout_seed, block_q, dropout_rate):
     B, H, Lq, D = q.shape
     if _use_pallas():
         nq = lambda x: x.reshape(B * H, x.shape[2], x.shape[3])  # noqa: E731
         kb = (jnp.repeat(key_bias, H, axis=0)
               if key_bias is not None else None)
-        out = _flash_fwd_pallas(nq(q), nq(k), nq(v), kb, block_q)
+        out = _flash_fwd_pallas(nq(q), nq(k), nq(v), kb, block_q,
+                                dropout_rate, dropout_seed)
         return out.reshape(B, H, Lq, D)
     mask = None
     if key_bias is not None:
         mask = (key_bias > NEG_INF / 2).astype(jnp.int32)[:, None, None, :]
-    return blockwise_attention(q, k, v, mask)
+    return blockwise_attention(q, k, v, mask, dropout_rate=dropout_rate,
+                               dropout_seed=dropout_seed)
 
 
-def _flash_fwd(q, k, v, key_bias, block_q):
-    return _flash_core(q, k, v, key_bias, block_q), (q, k, v, key_bias)
+def _flash_fwd(q, k, v, key_bias, dropout_seed, block_q, dropout_rate):
+    return (_flash_core(q, k, v, key_bias, dropout_seed, block_q,
+                        dropout_rate),
+            (q, k, v, key_bias, dropout_seed))
 
 
 # Backward-policy budget for the DENSE-VJP branch.  The dense backward
@@ -120,29 +145,190 @@ def _flash_fwd(q, k, v, key_bias, block_q):
 # scores_bytes by 3.  Measured on v5e (6L d512 transformer, bs=64, L=512):
 # full step 95 ms dense-bwd vs 163 ms blockwise-bwd; the blockwise VJP's
 # scan recompute only pays off once sequences outgrow this budget.
+# The default assumes a v5e-class chip (16 GB HBM) with the rest of the
+# step's working set resident; on smaller-memory platforms, or when the
+# model/optimizer state crowds HBM, override without editing source via
+# FDT_DENSE_BWD_BUDGET_MB (0 forces the blockwise VJP everywhere).
 _DENSE_BWD_BUDGET_BYTES = 2 << 30
 
 
-def _flash_bwd(block_q, res, g):
-    q, k, v, key_bias = res
+def _dense_bwd_budget_bytes() -> int:
+    mb = os.environ.get("FDT_DENSE_BWD_BUDGET_MB")
+    if mb is not None:
+        return int(mb) << 20
+    return _DENSE_BWD_BUDGET_BYTES
+
+
+def _flash_bwd_pallas(q, k, v, key_bias, dropout_seed, dropout_rate,
+                      block_q):
+    """Pallas backward kernel: dq/dk/dv with softmax stats RECOMPUTED
+    per q-block inside the kernel (K/V stay VMEM-resident, so the full
+    [block_q, Lk] score row costs one MXU matmul — no saved lse needed
+    and residuals stay (q, k, v, bias, seed)).
+
+    Math (m cancels out of out = acc/l, so treating it constant is
+    exact; delta_i = dO_i . out_i):
+      p    = exp(s - m),  l = sum_j p,  P~ = p * keep
+      dv_j = sum_i (P~_ij / l_i) dO_i
+      ds   = p * (keep * (dO V^T) - delta) / l * scale
+      dq_i = sum_j ds_ij k_j,   dk_j = sum_i ds_ij q_i
+    dk/dv accumulate across q-blocks by revisiting their (n)-indexed
+    output block — the TPU grid runs sequentially, i innermost.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    from faster_distributed_training_tpu.ops.attention import dropout_keep
+
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    N = B * H
+    scale = 1.0 / math.sqrt(D)
+    nq3 = lambda x: x.reshape(N, x.shape[2], x.shape[3])  # noqa: E731
+    qn, kn, vn = nq3(q), nq3(k), nq3(v)
+
+    if key_bias is None:
+        bias = jnp.zeros((B, Lk), jnp.float32)
+    else:
+        bias = key_bias
+    bias = jnp.repeat(bias, H, axis=0).reshape(N, 1, Lk).astype(jnp.float32)
+    seed = (dropout_seed if dropout_seed is not None
+            else jnp.uint32(0)).reshape(1, 1).astype(jnp.uint32)
+
+    # backward holds ~4 score-shaped fp32 tiles (s/p, dpterm, ds, keep):
+    # budget the q-tile to ~2 MB per tile so the working set stays well
+    # under VMEM next to the resident K/V
+    bq = 128
+    for cand in (512, 256, 128):
+        if cand * Lk * 4 <= 2 * 1024 * 1024:
+            bq = cand
+            break
+    bq = min(bq, Lq)
+    nq = -(-Lq // bq)
+    pad_q = nq * bq - Lq
+
+    def kernel(q_ref, k_ref, v_ref, b_ref, do_ref, s_ref,
+               dq_ref, dk_ref, dv_ref):
+        i = pl.program_id(1)
+        qb = q_ref[0]                                      # [bq, D]
+        do = do_ref[0].astype(jnp.float32)                 # [bq, D]
+        kk = k_ref[0]                                      # [Lk, D]
+        vv = v_ref[0]
+        s = jax.lax.dot_general(
+            qb, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [bq, Lk]
+        s = s + b_ref[0]
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+        if dropout_rate > 0.0:
+            n = pl.program_id(0)
+            qrow = (i * bq
+                    + jax.lax.broadcasted_iota(jnp.int32, (bq, Lk), 0))
+            kcol = jax.lax.broadcasted_iota(jnp.int32, (bq, Lk), 1)
+            keep = dropout_keep(s_ref[0, 0], n, qrow, kcol, dropout_rate)
+            pt = p * keep
+        else:
+            keep = None
+            pt = p
+        out = jnp.dot(pt.astype(vv.dtype), vv,
+                      preferred_element_type=jnp.float32) / l   # [bq, D]
+        delta = jnp.sum(do * out, axis=-1, keepdims=True)       # [bq, 1]
+        dpterm = jax.lax.dot_general(
+            do, vv.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [bq, Lk]
+        if keep is not None:
+            dpterm = dpterm * keep
+        ds = p * (dpterm - delta) / l * scale                   # [bq, Lk]
+        dq_ref[0] = jnp.dot(ds.astype(kk.dtype), kk,
+                            preferred_element_type=jnp.float32
+                            ).astype(dq_ref.dtype)
+        dk_blk = jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [Lk, D]
+        dv_blk = jax.lax.dot_general(
+            (pt / l).astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [Lk, D]
+
+        @pl.when(i == 0)
+        def _init():
+            dk_ref[0] = dk_blk.astype(dk_ref.dtype)
+            dv_ref[0] = dv_blk.astype(dv_ref.dtype)
+
+        @pl.when(i > 0)
+        def _acc():
+            dk_ref[0] += dk_blk.astype(dk_ref.dtype)
+            dv_ref[0] += dv_blk.astype(dv_ref.dtype)
+
+    qp = jnp.pad(qn, ((0, 0), (0, pad_q), (0, 0))) if pad_q else qn
+
+    def run(g):
+        gn = nq3(g)
+        gp = (jnp.pad(gn, ((0, 0), (0, pad_q), (0, 0))) if pad_q else gn)
+        dq, dk, dv = pl.pallas_call(
+            kernel,
+            grid=(N, nq),
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda n, i: (n, i, 0)),
+                pl.BlockSpec((1, Lk, D), lambda n, i: (n, 0, 0)),
+                pl.BlockSpec((1, Lk, D), lambda n, i: (n, 0, 0)),
+                pl.BlockSpec((1, 1, Lk), lambda n, i: (n, 0, 0)),
+                pl.BlockSpec((1, bq, D), lambda n, i: (n, i, 0)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, D), lambda n, i: (n, i, 0)),
+                pl.BlockSpec((1, Lk, D), lambda n, i: (n, 0, 0)),
+                pl.BlockSpec((1, Lk, D), lambda n, i: (n, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((N, nq * bq, D), jnp.float32),
+                jax.ShapeDtypeStruct((N, Lk, D), jnp.float32),
+                jax.ShapeDtypeStruct((N, Lk, D), jnp.float32),
+            ],
+            interpret=(jax.default_backend() != "tpu"),
+        )(qp, kn, vn, bias, gp, seed)
+        shape4 = lambda x, L: x.reshape(B, H, L, D)  # noqa: E731
+        return (shape4(dq[:, :Lq], Lq).astype(q.dtype),
+                shape4(dk, Lk).astype(k.dtype),
+                shape4(dv, Lk).astype(v.dtype))
+
+    return run
+
+
+def _flash_bwd(block_q, dropout_rate, res, g):
+    q, k, v, key_bias, dropout_seed = res
     mask = None
     if key_bias is not None:
         mask = (key_bias > NEG_INF / 2).astype(jnp.int32)[:, None, None, :]
     B, H, Lq, _ = q.shape
     Lk = k.shape[2]
     scores_bytes = 4 * B * H * Lq * Lk
-    if 3 * scores_bytes <= _DENSE_BWD_BUDGET_BYTES:
+    # every branch regenerates the forward's dropout mask from
+    # (seed, bh, q, k) indices — identical by construction (dropout_keep)
+    if 3 * scores_bytes <= _dense_bwd_budget_bytes():
         _, vjp = jax.vjp(
-            lambda q_, k_, v_: dense_attention_reference(q_, k_, v_, mask),
+            lambda q_, k_, v_: dense_attention_reference(
+                q_, k_, v_, mask, dropout_rate=dropout_rate,
+                dropout_seed=dropout_seed),
             q, k, v)
+        dq, dk, dv = vjp(g)
+    elif _use_pallas() and os.environ.get("FDT_DISABLE_PALLAS_BWD") != "1":
+        # long context on TPU: the Pallas backward kernel — recompute
+        # inside the kernel, O(L·block) memory, no XLA-derived VJP
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, key_bias, dropout_seed,
+                                       dropout_rate, block_q)(g)
     else:
-        # long context: recompute-in-backward via the blockwise formulation
-        # keeps peak memory O(L*block) at the price of the scan recompute
+        # long context off-TPU: recompute-in-backward via the blockwise
+        # formulation keeps peak memory O(L*block) at the price of the
+        # scan recompute
         _, vjp = jax.vjp(
-            lambda q_, k_, v_: blockwise_attention(q_, k_, v_, mask),
+            lambda q_, k_, v_: blockwise_attention(
+                q_, k_, v_, mask, dropout_rate=dropout_rate,
+                dropout_seed=dropout_seed),
             q, k, v)
-    dq, dk, dv = vjp(g)
-    return dq, dk, dv, None
+        dq, dk, dv = vjp(g)
+    return dq, dk, dv, None, None
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
@@ -163,14 +349,20 @@ def _auto_block_q(lq: int, lk: int) -> int:
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     mask: Optional[jax.Array] = None,
-                    block_q: Optional[int] = None) -> jax.Array:
-    """Drop-in for dense_attention (models/transformer.py:101-111), minus
-    attention-prob dropout (probabilities are never materialized).
+                    block_q: Optional[int] = None,
+                    dropout_rate: float = 0.0,
+                    dropout_seed: Optional[jax.Array] = None) -> jax.Array:
+    """Drop-in for dense_attention (models/transformer.py:101-111),
+    INCLUDING attention-prob dropout (transformer.py:190-192): the keep
+    mask is an index hash (ops.attention.dropout_keep) computed inside
+    the kernel, so probabilities still never touch HBM.
 
     q/k/v: [B, H, L, D].  mask: None or a key-padding mask broadcastable
     to [B, 1, 1, Lk] (mask==0 masked) — full [B,H,Lq,Lk] masks should use
     blockwise_attention directly.  block_q: q-tile rows; None picks the
     largest tile whose score buffer fits VMEM (_auto_block_q).
+    dropout_rate/dropout_seed: training-path prob dropout; pass a fresh
+    u32 seed per step (e.g. jax.random.bits of the step's dropout rng).
     """
     if block_q is None:
         block_q = _auto_block_q(q.shape[2], k.shape[2])
@@ -181,4 +373,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             kb = kb.reshape(kb.shape[0], kb.shape[-1])
         kb = jnp.broadcast_to(kb, (q.shape[0], k.shape[2]))
         key_bias = mask_to_bias(kb)
-    return _flash_core(q, k, v, key_bias, block_q)
+    seed = (jnp.uint32(0) if dropout_seed is None
+            else dropout_seed.astype(jnp.uint32))
+    return _flash_core(q, k, v, key_bias, seed, block_q,
+                       float(dropout_rate))

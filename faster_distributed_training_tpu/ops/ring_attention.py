@@ -27,19 +27,30 @@ from jax import lax
 from jax.sharding import Mesh
 
 from faster_distributed_training_tpu.ops.attention import (
-    NEG_INF, finalize, init_carry, mask_to_bias, online_block_update)
+    NEG_INF, dropout_keep, finalize, init_carry, mask_to_bias,
+    online_block_update)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str,
                    key_bias: Optional[jax.Array] = None,
-                   causal: bool = False) -> jax.Array:
+                   causal: bool = False,
+                   dropout_rate: float = 0.0,
+                   dropout_seed: Optional[jax.Array] = None,
+                   dropout_bh: Optional[jax.Array] = None) -> jax.Array:
     """Ring attention body — call INSIDE shard_map, sequence sharded on
     `axis_name`.
 
     q/k/v: [B, H, L_local, D] (this device's sequence shard),
     key_bias: [B, L_local] additive key bias (0 keep / NEG_INF drop) for
     this shard's keys, or None.  Returns [B, H, L_local, D].
+
+    dropout_rate > 0 applies attention-prob dropout via the index hash
+    (ops.attention.dropout_keep) with GLOBAL (stream, q, k) coordinates
+    — sequence positions are already global here (idx/src · L + pos) and
+    `dropout_bh` carries the caller's global batch·head index — so the
+    pattern equals the dense/flash one for the same seed regardless of
+    sp placement.
     """
     B, H, L, D = q.shape
     sp = lax.axis_size(axis_name)
@@ -50,18 +61,29 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         key_bias = jnp.zeros((B, L), jnp.float32)
 
     pos = jnp.arange(L, dtype=jnp.int32)
+    if dropout_bh is None:
+        dropout_bh = (jnp.arange(B, dtype=jnp.int32)[:, None] * H
+                      + jnp.arange(H, dtype=jnp.int32)[None, :]
+                      )[:, :, None, None]
+    seed = (jnp.uint32(0) if dropout_seed is None
+            else dropout_seed.astype(jnp.uint32))
 
     @jax.checkpoint
     def body(carry, _):
         k_cur, v_cur, b_cur, src, m, l, acc = carry
         bias = b_cur[:, None, None, :]                    # [B,1,1,L]
+        q_pos = idx * L + pos                             # global positions
+        k_pos = src * L + pos
         if causal:
-            q_pos = idx * L + pos                         # global positions
-            k_pos = src * L + pos
             bias = bias + jnp.where(k_pos[None, :] <= q_pos[:, None],
                                     0.0, NEG_INF)[None, None]
+        keep = None
+        if dropout_rate > 0.0:
+            keep = dropout_keep(seed, dropout_bh,
+                                q_pos[None, None, :, None],
+                                k_pos[None, None, None, :], dropout_rate)
         m, l, acc = online_block_update(q, k_cur, v_cur, bias, m, l, acc,
-                                        scale)
+                                        scale, keep_blk=keep)
         # rotate the K/V shard to the next rank; XLA overlaps the ICI
         # transfer with the next step's matmuls where possible
         k_cur = lax.ppermute(k_cur, axis_name, perm)
@@ -81,18 +103,23 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return finalize(m, l, acc, q.dtype)
 
 
-def _ring_body(q, k, v, axis_name, key_mask=None, causal=False):
+def _ring_body(q, k, v, axis_name, key_mask=None, causal=False,
+               dropout_rate=0.0, dropout_seed=None, dropout_bh=None):
     """sequence_parallel.sp_self_attention body shim: per-shard keep-mask
     -> additive bias (elementwise, so per-shard == global conversion)."""
     key_bias = None if key_mask is None else mask_to_bias(key_mask)
     return ring_attention(q, k, v, axis_name, key_bias=key_bias,
-                          causal=causal)
+                          causal=causal, dropout_rate=dropout_rate,
+                          dropout_seed=dropout_seed, dropout_bh=dropout_bh)
 
 
 def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         mask: Optional[jax.Array], mesh: Mesh,
                         sp_axis: str = "sp",
-                        causal: bool = False) -> jax.Array:
+                        causal: bool = False,
+                        dropout_rate: float = 0.0,
+                        dropout_seed: Optional[jax.Array] = None
+                        ) -> jax.Array:
     """shard_map wrapper: globally-shaped [B,H,L,D] in and out, with L
     sharded over `sp_axis`, B over the data axes, heads over tp when
     divisible (shared scaffolding: ops/sequence_parallel.py).
@@ -102,4 +129,6 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         sp_self_attention)
 
     return sp_self_attention(_ring_body, q, k, v, mask, mesh,
-                             sp_axis=sp_axis, causal=causal)
+                             sp_axis=sp_axis, causal=causal,
+                             dropout_rate=dropout_rate,
+                             dropout_seed=dropout_seed)

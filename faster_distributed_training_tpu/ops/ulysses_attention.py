@@ -38,13 +38,23 @@ from faster_distributed_training_tpu.ops.attention import blockwise_attention
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str,
                       key_mask: Optional[jax.Array] = None,
-                      causal: bool = False) -> jax.Array:
+                      causal: bool = False,
+                      dropout_rate: float = 0.0,
+                      dropout_seed: Optional[jax.Array] = None,
+                      dropout_bh: Optional[jax.Array] = None) -> jax.Array:
     """Ulysses body — call INSIDE shard_map, sequence sharded on `axis_name`.
 
     q/k/v: [B, H, L_local, D] (this device's sequence shard); H must be
     divisible by the axis size.  key_mask: [B, L_local] boolean/0-1 key
     keep-mask for this shard's keys (0 = masked), or None.
     Returns [B, H, L_local, D].
+
+    dropout_rate > 0 applies attention-prob hash dropout inside the
+    inner blockwise attention.  `dropout_bh` is the caller's global
+    [B,H_loc,1,1] batch·head index for the PRE-swap heads; after the
+    all_to_all this device holds heads [j·H_loc/sp, (j+1)·H_loc/sp) of
+    that range (j = this device's sp index), so the matching slice keeps
+    the pattern equal to the dense/flash one for the same seed.
     """
     B, H, L_loc, D = q.shape
     sp = lax.axis_size(axis_name)
@@ -67,10 +77,24 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         full = lax.all_gather(key_mask, axis_name, axis=1, tiled=True)
         mask4 = (full != 0)[:, None, None, :]                # [B,1,1,L]
 
+    bh_post = None
+    if dropout_rate > 0.0:
+        if dropout_bh is None:
+            dropout_bh = (jnp.arange(B, dtype=jnp.int32)[:, None] * H
+                          + jnp.arange(H, dtype=jnp.int32)[None, :]
+                          )[:, :, None, None]
+        j = lax.axis_index(axis_name)
+        h_per = H // sp
+        # this device's post-swap head slice of the global index table
+        bh_post = lax.dynamic_slice_in_dim(dropout_bh, j * h_per, h_per,
+                                           axis=1)
+
     # full-length attention on H/sp heads; blockwise keeps memory O(L·blk)
     out = blockwise_attention(qh, kh, vh, mask=mask4,
                               block_k=min(512, qh.shape[2]),
-                              causal=causal)
+                              causal=causal, dropout_rate=dropout_rate,
+                              dropout_seed=dropout_seed,
+                              dropout_bh=bh_post)
 
     # head-sharded [B, H/sp, L, D] -> seq-sharded [B, H, L/sp, D]
     return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
@@ -80,7 +104,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            mask: Optional[jax.Array], mesh: Mesh,
                            sp_axis: str = "sp",
-                           causal: bool = False) -> jax.Array:
+                           causal: bool = False,
+                           dropout_rate: float = 0.0,
+                           dropout_seed: Optional[jax.Array] = None
+                           ) -> jax.Array:
     """shard_map wrapper mirroring ring_self_attention: globally-shaped
     [B,H,L,D] in/out with L sharded over `sp_axis`, B over the data axes,
     heads over tp when H % (tp * sp) == 0 (shared scaffolding:
@@ -94,4 +121,6 @@ def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     sp = mesh.shape[sp_axis] if sp_axis in mesh.axis_names else 1
     return sp_self_attention(ulysses_attention, q, k, v, mask, mesh,
                              sp_axis=sp_axis, causal=causal,
-                             heads_per_shard_divisor=sp)
+                             heads_per_shard_divisor=sp,
+                             dropout_rate=dropout_rate,
+                             dropout_seed=dropout_seed)

@@ -18,10 +18,20 @@
 //   fdt_gather_u8      — index-gather of uint8 rows into a contiguous
 //                        batch buffer (the BatchLoader image collate)
 //   fdt_crc32          — zlib-compatible CRC32 (dataset integrity checks)
+//   fdt_wp_load        — register a WordPiece vocabulary (newline-joined
+//                        tokens, id = line index) -> handle
+//   fdt_wp_encode_batch— greedy longest-match WordPiece over CLEANED
+//                        ASCII text (== data/wordpiece.py
+//                        WordPieceTokenizer.encode on the clean_text
+//                        output); returns a fallback code on any byte
+//                        outside the cleaned alphabet so the Python
+//                        reference handles general Unicode
 
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <string>
+#include <unordered_map>
 #include <unordered_set>
 #include <vector>
 
@@ -156,6 +166,52 @@ std::string clean_impl(const std::string& raw) {
   return out;
 }
 
+// ------------------------------------------------------------- wordpiece
+struct WpVocab {
+  std::unordered_map<std::string, int32_t> map;
+};
+
+std::vector<std::unique_ptr<WpVocab>>& wp_registry() {
+  static std::vector<std::unique_ptr<WpVocab>> reg;
+  return reg;
+}
+
+constexpr int kWpMaxCharsPerWord = 100;  // HF WordpieceTokenizer default
+
+// Greedy longest-match-first segmentation of one word; appends piece ids
+// (unk_id for an unsegmentable word).  Mirrors data/wordpiece.py
+// wordpiece_word.
+void wp_segment(const WpVocab& v, const std::string& word, int32_t unk_id,
+                std::vector<int32_t>* out) {
+  if (word.size() > kWpMaxCharsPerWord) {
+    out->push_back(unk_id);
+    return;
+  }
+  std::vector<int32_t> pieces;
+  size_t start = 0;
+  while (start < word.size()) {
+    size_t end = word.size();
+    int32_t cur = -1;
+    while (start < end) {
+      std::string piece = word.substr(start, end - start);
+      if (start > 0) piece = "##" + piece;
+      auto it = v.map.find(piece);
+      if (it != v.map.end()) {
+        cur = it->second;
+        break;
+      }
+      --end;
+    }
+    if (cur < 0) {
+      out->push_back(unk_id);
+      return;
+    }
+    pieces.push_back(cur);
+    start = end;
+  }
+  out->insert(out->end(), pieces.begin(), pieces.end());
+}
+
 }  // namespace
 
 extern "C" {
@@ -201,6 +257,78 @@ int32_t fdt_encode_batch(const char** texts, int32_t n, int32_t max_len,
         row[pos++] = static_cast<int32_t>(h) + reserved;
       }
     }
+    row[pos++] = sep_id;
+    out_lens[b] = pos;
+    for (; pos < max_len; ++pos) row[pos] = pad_id;
+  }
+  return 0;
+}
+
+// Register a WordPiece vocabulary: `data[0:len)` is newline-joined tokens,
+// id = line index (HF vocab.txt format).  Returns a handle >= 0.
+int32_t fdt_wp_load(const char* data, int64_t len) {
+  auto v = std::make_unique<WpVocab>();
+  int32_t id = 0;
+  int64_t start = 0;
+  for (int64_t i = 0; i <= len; ++i) {
+    if (i == len || data[i] == '\n') {
+      if (i > start)
+        v->map.emplace(std::string(data + start, i - start), id);
+      ++id;
+      start = i + 1;
+    }
+  }
+  wp_registry().push_back(std::move(v));
+  return static_cast<int32_t>(wp_registry().size()) - 1;
+}
+
+// WordPiece-encode a batch of CLEANED texts ([a-z0-9' ] alphabet, the
+// clean_text output): per word, apostrophes split off as punctuation
+// tokens (HF BasicTokenizer._run_split_on_punc restricted to the cleaned
+// alphabet), then greedy longest-match.  Frame per row:
+// [CLS] + pieces[:max_len-2] + [SEP], right-padded with pad_id.
+// Returns 0 ok, -1 bad args, -2 when a text contains a byte outside the
+// cleaned alphabet (caller must fall back to the Python reference, which
+// handles full Unicode).
+int32_t fdt_wp_encode_batch(int32_t handle, const char** texts, int32_t n,
+                            int32_t max_len, int32_t cls_id, int32_t sep_id,
+                            int32_t unk_id, int32_t pad_id,
+                            int32_t* out_tokens, int32_t* out_lens) {
+  if (handle < 0 ||
+      handle >= static_cast<int32_t>(wp_registry().size()) || max_len < 2)
+    return -1;
+  const WpVocab& v = *wp_registry()[handle];
+  std::vector<int32_t> ids;
+  std::string word;
+  for (int32_t b = 0; b < n; ++b) {
+    ids.clear();
+    const char* t = texts[b];
+    size_t len = std::strlen(t);
+    for (size_t i = 0; i < len; ++i) {
+      char c = t[i];
+      bool ok = (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9') ||
+                c == '\'' || c == ' ';
+      if (!ok) return -2;
+    }
+    word.clear();
+    for (size_t i = 0; i <= len; ++i) {
+      char c = i < len ? t[i] : ' ';
+      if (c == ' ' || c == '\'') {
+        if (!word.empty()) {
+          wp_segment(v, word, unk_id, &ids);
+          word.clear();
+        }
+        if (c == '\'') wp_segment(v, "'", unk_id, &ids);
+      } else {
+        word += c;
+      }
+    }
+    int32_t* row = out_tokens + static_cast<int64_t>(b) * max_len;
+    int32_t body = static_cast<int32_t>(ids.size());
+    if (body > max_len - 2) body = max_len - 2;
+    int32_t pos = 0;
+    row[pos++] = cls_id;
+    for (int32_t i = 0; i < body; ++i) row[pos++] = ids[i];
     row[pos++] = sep_id;
     out_lens[b] = pos;
     for (; pos < max_len; ++pos) row[pos] = pad_id;

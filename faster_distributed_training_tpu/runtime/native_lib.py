@@ -67,6 +67,14 @@ def load() -> Optional[ctypes.CDLL]:
             lib.fdt_gather_u8.argtypes = [
                 ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
                 ctypes.c_int32, ctypes.c_int64, ctypes.c_char_p]
+            lib.fdt_wp_load.restype = ctypes.c_int32
+            lib.fdt_wp_load.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+            lib.fdt_wp_encode_batch.restype = ctypes.c_int32
+            lib.fdt_wp_encode_batch.argtypes = [
+                ctypes.c_int32, ctypes.POINTER(ctypes.c_char_p),
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
             _lib = lib
         except Exception:
             _load_failed = True
@@ -119,6 +127,43 @@ def encode_batch(texts: List[str], max_len: int, vocab_size: int,
     arr = (ctypes.c_char_p * n)(*[t.encode("utf-8", "ignore") for t in texts])
     rc = lib.fdt_encode_batch(
         arr, n, max_len, vocab_size, pad_id, cls_id, sep_id, reserved,
+        tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc != 0:
+        return None
+    return tokens, lens
+
+
+def wp_load(vocab_lines: List[str]) -> Optional[int]:
+    """Register a WordPiece vocab (id = list index) with the native core;
+    returns a handle, or None when the library is unavailable.  The caller
+    owns the handle (register once per tokenizer, not per batch)."""
+    lib = load()
+    if lib is None:
+        return None
+    blob = "\n".join(vocab_lines).encode("utf-8")
+    h = lib.fdt_wp_load(blob, len(blob))
+    return None if h < 0 else h
+
+
+def wp_encode_batch(handle: int, texts: List[str], max_len: int,
+                    cls_id: int, sep_id: int, unk_id: int, pad_id: int
+                    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native WordPiece batch encode of CLEANED ([a-z0-9' ]) texts.
+    Returns (tokens [n, max_len] int32, lens [n] int32), or None when the
+    library is unavailable or a text needs the full-Unicode Python path."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(texts)
+    tokens = np.empty((n, max_len), np.int32)
+    lens = np.empty((n,), np.int32)
+    try:
+        arr = (ctypes.c_char_p * n)(*[t.encode("ascii") for t in texts])
+    except UnicodeEncodeError:
+        return None
+    rc = lib.fdt_wp_encode_batch(
+        handle, arr, n, max_len, cls_id, sep_id, unk_id, pad_id,
         tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     if rc != 0:

@@ -132,6 +132,15 @@ class Trainer:
             # only recovery is manual re-launch with --resume, SURVEY.md
             # §5): a non-finite epoch loss means the run is poisoned; roll
             # back to the last good checkpoint and keep going.
+            if "loss" not in train_m:
+                # zero batches ran — a data/config problem (dataset smaller
+                # than one per-host batch, bad shard), not divergence;
+                # letting auto_recover roll back would burn recovery slots
+                # on an error a retry can never fix
+                raise RuntimeError(
+                    f"epoch {epoch} produced no batches — dataset too small "
+                    f"for batch_size={cfg.batch_size} x "
+                    f"{jax.process_count()} process(es)?")
             if cfg.auto_recover and not _finite(train_m.get("loss")):
                 consecutive_failures += 1
                 if consecutive_failures > cfg.max_recoveries:

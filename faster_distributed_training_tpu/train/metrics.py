@@ -31,7 +31,12 @@ class MetricAccumulator:
         n_steps = max(len(v) for v in vals.values()) if vals else 0
         for k, arr in vals.items():
             out[k + "_sum"] = float(arr.sum())
-        if "loss" in vals and n_steps:
+        if "loss_total" in vals and "total" in vals and vals["total"].sum():
+            # exact sample-weighted loss — correct even when the final
+            # (padded) eval batch holds fewer valid samples than the rest
+            out["loss"] = float(vals["loss_total"].sum()
+                                / vals["total"].sum())
+        elif "loss" in vals and n_steps:
             out["loss"] = float(vals["loss"].mean())
         if "correct" in vals and "total" in vals:
             total = float(vals["total"].sum())

@@ -86,11 +86,21 @@ def attn_mixup_apply(lam_param: jax.Array, key: jax.Array, x: jax.Array,
                      ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Attention-map mixup (resnet50_test.py:417-424): per-pixel sigmoid
     map mixes the images; the per-sample loss weight is the map's squared
-    norm (the reference's ``lam_scale``)."""
+    norm (the reference's ``lam_scale``) — NORMALIZED by the pixel count.
+
+    Deliberate delta: the reference computes the raw inner product
+    ``flat @ flat`` over all H*W*C sigmoid values (resnet50_test.py:
+    420-424), a weight of order 10^3 — which makes the paired criterion
+    ``lam*CE_a + (1-lam)*CE_b`` unbounded below (the (1-lam) term is
+    ~-10^3), so training on that dead code path could only diverge
+    (observed empirically: loss runs to large negative values within one
+    epoch).  The mean of squares keeps the exact semantics — "how much
+    of sample a survives the map, quadratically" — in [0, 1], where the
+    mixup criterion is a genuine convex combination."""
     index = jax.random.permutation(key, x.shape[0])
     lam_map = jax.nn.sigmoid(lam_param).astype(x.dtype)
     mixed = lam_map * x + (1.0 - lam_map) * x[index]
-    lam_scale = jnp.sum(lam_map.reshape(x.shape[0], -1) ** 2, axis=1)
+    lam_scale = jnp.mean(lam_map.reshape(x.shape[0], -1) ** 2, axis=1)
     return mixed, y, y[index], lam_scale
 
 

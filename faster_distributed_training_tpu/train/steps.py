@@ -191,10 +191,23 @@ def make_eval_step(cfg: TrainConfig) -> Callable[[TrainState, Any],
         else:
             logits = state.apply_fn(variables, batch["image"], train=False)
         y = batch["label"]
-        loss = cross_entropy(logits, y)
-        correct = jnp.sum(jnp.argmax(logits, axis=-1) == y)
-        return {"loss": loss.astype(jnp.float32),
+        hit = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
+        losses = per_sample_cross_entropy(logits, y)
+        valid = batch.get("valid")
+        if valid is None:
+            loss_total = jnp.sum(losses)
+            correct = jnp.sum(hit)
+            total = jnp.asarray(y.shape[0], jnp.float32)
+        else:
+            # padded final batch (BatchLoader pad_last): padding samples
+            # carry valid=0 and contribute to nothing
+            loss_total = jnp.sum(losses * valid)
+            correct = jnp.sum(hit * valid)
+            total = jnp.sum(valid)
+        return {"loss": (loss_total / jnp.maximum(total, 1.0)
+                         ).astype(jnp.float32),
+                "loss_total": loss_total.astype(jnp.float32),
                 "correct": correct.astype(jnp.float32),
-                "total": jnp.asarray(y.shape[0], jnp.float32)}
+                "total": total}
 
     return step

@@ -318,3 +318,224 @@ class TestFlashBackwardPolicy:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5,
                                        err_msg=f"vs dense ref on {name}")
+
+
+class TestAttentionDropout:
+    """Attention-prob dropout on the never-materialized paths
+    (VERDICT r1 missing #3): the index-hash mask (ops.attention.
+    dropout_keep) must (a) actually drop ~rate of the probability mass,
+    (b) produce IDENTICAL outputs across dense-hash / blockwise / Pallas
+    / ring / ulysses for the same seed — including under sp sharding —
+    and (c) regenerate exactly in both flash backward branches."""
+
+    RATE = 0.3
+
+    def _seed(self):
+        return jnp.uint32(20240730)
+
+    def test_keep_fraction_and_scaling(self):
+        from faster_distributed_training_tpu.ops.attention import dropout_keep
+        bh = jnp.arange(8, dtype=jnp.int32)[:, None, None].reshape(8, 1, 1, 1)
+        qi = jnp.arange(64, dtype=jnp.int32)[None, None, :, None]
+        ki = jnp.arange(64, dtype=jnp.int32)[None, None, None, :]
+        keep = dropout_keep(self._seed(), bh, qi, ki, self.RATE)
+        vals = np.asarray(keep).ravel()
+        frac_dropped = float((vals == 0.0).mean())
+        assert abs(frac_dropped - self.RATE) < 0.02
+        kept = vals[vals > 0]
+        np.testing.assert_allclose(kept, 1.0 / (1.0 - self.RATE), rtol=1e-6)
+        # E[keep] == 1 (unbiased)
+        assert abs(float(vals.mean()) - 1.0) < 0.02
+        # seed changes the pattern
+        keep2 = dropout_keep(jnp.uint32(7), bh, qi, ki, self.RATE)
+        assert not np.array_equal(np.asarray(keep), np.asarray(keep2))
+
+    def test_blockwise_matches_dense_hash(self):
+        q, k, v = _qkv(jax.random.PRNGKey(60), B=2, H=2, L=32, D=16)
+        mask = _padding_mask(jax.random.PRNGKey(61), B=2, L=32)[:, None,
+                                                                None, :]
+        out = blockwise_attention(q, k, v, mask, block_k=8,
+                                  dropout_rate=self.RATE,
+                                  dropout_seed=self._seed())
+        ref = dense_attention_reference(q, k, v, mask,
+                                        dropout_rate=self.RATE,
+                                        dropout_seed=self._seed())
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        # and differs from the undropped output
+        clean = dense_attention_reference(q, k, v, mask)
+        assert not np.allclose(np.asarray(out), np.asarray(clean),
+                               atol=1e-3)
+
+    def test_pallas_interpret_matches_dense_hash(self):
+        os.environ["FDT_FORCE_PALLAS_INTERPRET"] = "1"
+        try:
+            q, k, v = _qkv(jax.random.PRNGKey(62), L=16, D=8)
+            mask = _padding_mask(jax.random.PRNGKey(63),
+                                 L=16)[:, None, None, :]
+            out = flash_attention(q, k, v, mask, block_q=8,
+                                  dropout_rate=self.RATE,
+                                  dropout_seed=self._seed())
+            ref = dense_attention_reference(q, k, v, mask,
+                                            dropout_rate=self.RATE,
+                                            dropout_seed=self._seed())
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5)
+        finally:
+            del os.environ["FDT_FORCE_PALLAS_INTERPRET"]
+
+    def test_flash_backward_branches_regenerate_mask(self, monkeypatch):
+        import importlib
+        fa = importlib.import_module(
+            "faster_distributed_training_tpu.ops.flash_attention")
+        q, k, v = _qkv(jax.random.PRNGKey(64), B=2, H=2, L=32, D=16)
+
+        def grads(budget):
+            monkeypatch.setattr(fa, "_DENSE_BWD_BUDGET_BYTES", budget)
+
+            def loss(q_, k_, v_):
+                return jnp.sum(fa.flash_attention(
+                    q_, k_, v_, dropout_rate=self.RATE,
+                    dropout_seed=self._seed()) ** 2)
+
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        g_dense = grads(1 << 40)
+        g_block = grads(0)
+
+        def loss_ref(q_, k_, v_):
+            return jnp.sum(dense_attention_reference(
+                q_, k_, v_, dropout_rate=self.RATE,
+                dropout_seed=self._seed()) ** 2)
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g_dense, g_block):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=f"branches differ on {name}")
+        for name, a, b in zip("qkv", g_dense, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=f"vs hash-dense ref on {name}")
+
+    def test_ring_matches_dense_hash_under_sharding(self, devices8):
+        mesh = make_mesh(("dp", "sp"), (2, 4), devices8)
+        q, k, v = _qkv(jax.random.PRNGKey(65), B=4, H=2, L=32, D=16)
+        mask = _padding_mask(jax.random.PRNGKey(66), B=4, L=32)
+        out = ring_self_attention(q, k, v, mask, mesh,
+                                  dropout_rate=self.RATE,
+                                  dropout_seed=self._seed())
+        ref = dense_attention_reference(q, k, v, mask[:, None, None, :],
+                                        dropout_rate=self.RATE,
+                                        dropout_seed=self._seed())
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_ulysses_matches_dense_hash_under_sharding(self, devices8):
+        from faster_distributed_training_tpu.ops.ulysses_attention import (
+            ulysses_self_attention)
+        mesh = make_mesh(("dp", "sp"), (2, 4), devices8)
+        q, k, v = _qkv(jax.random.PRNGKey(67), B=4, H=4, L=32, D=16)
+        mask = _padding_mask(jax.random.PRNGKey(68), B=4, L=32)
+        out = ulysses_self_attention(q, k, v, mask, mesh,
+                                     dropout_rate=self.RATE,
+                                     dropout_seed=self._seed())
+        ref = dense_attention_reference(q, k, v, mask[:, None, None, :],
+                                        dropout_rate=self.RATE,
+                                        dropout_seed=self._seed())
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_transformer_flash_train_path_uses_dropout(self):
+        """The auto-selected TPU path must regularize in training: same
+        params + same rngs, dropout_attention on vs off must differ in
+        the train forward (eval stays deterministic and equal)."""
+        from faster_distributed_training_tpu.models import Transformer
+
+        def build(rate):
+            return Transformer(n_class=4, vocab=64, n_layers=1, h=2,
+                               d_model=16, d_ff=32, d_hidden=32, maxlen=16,
+                               dropout_attention=rate,
+                               dropout_encodings=0.0,
+                               dropout_connection_attention=0.0,
+                               dropout_connection_ffn=0.0, dropout_ffn=0.0,
+                               attention_impl="flash", alpha=0.0)
+
+        x = jnp.ones((4, 16), jnp.int32)
+        rngs = {"params": jax.random.PRNGKey(0),
+                "dropout": jax.random.PRNGKey(1),
+                "mixup": jax.random.PRNGKey(2)}
+        m_on, m_off = build(0.5), build(0.0)
+        params = m_off.init(rngs, x, train=False)
+        run = lambda m, train: m.apply(  # noqa: E731
+            params, x, train=train,
+            rngs={"dropout": jax.random.PRNGKey(3),
+                  "mixup": jax.random.PRNGKey(4)})
+        on_logits = run(m_on, True)[0]
+        off_logits = run(m_off, True)[0]
+        assert not np.allclose(np.asarray(on_logits),
+                               np.asarray(off_logits), atol=1e-4)
+        ev_on = m_on.apply(params, x, train=False)
+        ev_off = m_off.apply(params, x, train=False)
+        np.testing.assert_allclose(np.asarray(ev_on), np.asarray(ev_off),
+                                   rtol=1e-6)
+
+
+class TestPallasBackwardKernel:
+    """The Pallas flash backward (dq/dk/dv recomputed in-kernel) must be
+    gradient-equal to the dense reference, with and without dropout,
+    including ragged q (pad rows) and padding masks — interpret mode."""
+
+    def _grads_kernel(self, q, k, v, mask=None, rate=0.0, seed=None,
+                      monkeypatch=None):
+        import importlib
+        fa = importlib.import_module(
+            "faster_distributed_training_tpu.ops.flash_attention")
+        # force the long-context branch so the kernel path is taken
+        monkeypatch.setattr(fa, "_DENSE_BWD_BUDGET_BYTES", 0)
+        os.environ["FDT_FORCE_PALLAS_INTERPRET"] = "1"
+        try:
+            def loss(q_, k_, v_):
+                return jnp.sum(fa.flash_attention(
+                    q_, k_, v_, mask=mask, block_q=8, dropout_rate=rate,
+                    dropout_seed=seed) ** 2)
+
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        finally:
+            del os.environ["FDT_FORCE_PALLAS_INTERPRET"]
+
+    def _grads_ref(self, q, k, v, mask=None, rate=0.0, seed=None):
+        def loss(q_, k_, v_):
+            return jnp.sum(dense_attention_reference(
+                q_, k_, v_, mask, dropout_rate=rate,
+                dropout_seed=seed) ** 2)
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    def _check(self, got, want):
+        for name, a, b in zip("qkv", got, want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_matches_dense_no_mask(self, monkeypatch):
+        q, k, v = _qkv(jax.random.PRNGKey(70), B=2, H=2, L=16, D=8)
+        self._check(self._grads_kernel(q, k, v, monkeypatch=monkeypatch),
+                    self._grads_ref(q, k, v))
+
+    def test_matches_dense_with_mask_and_ragged_q(self, monkeypatch):
+        # L=12 with block_q=8 -> one ragged (padded) q block
+        q, k, v = _qkv(jax.random.PRNGKey(71), B=2, H=2, L=12, D=8)
+        mask = _padding_mask(jax.random.PRNGKey(72), B=2,
+                             L=12)[:, None, None, :]
+        self._check(
+            self._grads_kernel(q, k, v, mask, monkeypatch=monkeypatch),
+            self._grads_ref(q, k, v, mask))
+
+    def test_matches_dense_with_dropout(self, monkeypatch):
+        q, k, v = _qkv(jax.random.PRNGKey(73), B=2, H=2, L=16, D=8)
+        seed = jnp.uint32(99)
+        self._check(
+            self._grads_kernel(q, k, v, rate=0.3, seed=seed,
+                               monkeypatch=monkeypatch),
+            self._grads_ref(q, k, v, rate=0.3, seed=seed))

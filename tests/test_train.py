@@ -10,9 +10,9 @@ from faster_distributed_training_tpu.config import TrainConfig
 from faster_distributed_training_tpu.models import resnet18, Transformer
 from faster_distributed_training_tpu.optim import build_optimizer
 from faster_distributed_training_tpu.train import (
-    create_train_state, fresh_loss_scale, init_meta_lambda, make_eval_step,
-    make_train_step, mixup_data, meta_mixup_apply, mixup_criterion,
-    unscale_and_check, update_loss_scale)
+    create_train_state, fresh_loss_scale, init_attn_lambda, init_meta_lambda,
+    make_eval_step, make_train_step, mixup_data, meta_mixup_apply,
+    mixup_criterion, unscale_and_check, update_loss_scale)
 from faster_distributed_training_tpu.train.losses import cross_entropy
 
 
@@ -51,6 +51,20 @@ class TestMixup:
         assert g.shape == lam_p.shape
         assert float(jnp.abs(g).sum()) > 0.0
 
+    def test_attn_lam_scale_bounded(self):
+        # the loss weight must stay a convex-combination coefficient:
+        # the reference's raw flat@flat (resnet50_test.py:420-424) is
+        # ~10^3, making lam*CE_a+(1-lam)*CE_b unbounded below
+        from faster_distributed_training_tpu.train import (attn_mixup_apply,
+                                                           init_attn_lambda)
+        key = jax.random.PRNGKey(5)
+        lam_p = init_attn_lambda(key, 4, 8, 8, 3) * 100 - 50  # extreme logits
+        x = jax.random.normal(jax.random.fold_in(key, 1), (4, 8, 8, 3))
+        y = jnp.arange(4) % 2
+        _, _, _, lam = attn_mixup_apply(lam_p, key, x, y)
+        assert lam.shape == (4,)
+        assert float(lam.min()) >= 0.0 and float(lam.max()) <= 1.0
+
     def test_mixup_criterion(self):
         logits = jnp.asarray([[5.0, 0.0], [0.0, 5.0]])
         y_a = jnp.asarray([0, 1])
@@ -85,8 +99,13 @@ def _resnet_setup(mixup_mode="static", meta=False, precision="fp32", bs=8):
                       lr=0.01, epochs=2)
     model = resnet18(num_classes=10)
     tx, _ = build_optimizer(cfg, steps_per_epoch=2)
-    extra = ({"mixup_lambda": init_meta_lambda(jax.random.PRNGKey(9), bs)}
-             if mixup_mode in ("meta", "attn") else None)
+    if mixup_mode == "meta":
+        extra = {"mixup_lambda": init_meta_lambda(jax.random.PRNGKey(9), bs)}
+    elif mixup_mode == "attn":
+        extra = {"mixup_lambda": init_attn_lambda(jax.random.PRNGKey(9), bs,
+                                                  32, 32, 3)}
+    else:
+        extra = None
     sample = jnp.zeros((bs, 32, 32, 3), jnp.float32)
     state = create_train_state(model, tx, sample, jax.random.PRNGKey(0),
                                init_kwargs={"train": False},
@@ -117,11 +136,47 @@ class TestSteps:
         lam1 = np.asarray(state.params["mixup_lambda"])
         assert not np.allclose(lam0, lam1), "meta-lambda must actually train"
 
+    def test_resnet_attn_mixup_trains_pixel_map(self):
+        # attn mode must use a genuine per-pixel NHWC map
+        # (resnet50_test.py:404-424), not a degenerate per-sample scalar,
+        # and the map itself must receive optimizer updates — not just
+        # the pixels the scalar path would touch
+        cfg, state, batch = _resnet_setup(mixup_mode="attn")
+        lam = state.params["mixup_lambda"]
+        assert lam.shape == (8, 32, 32, 3), "attn lambda must be per-pixel"
+        lam0 = np.asarray(lam).copy()
+        step = jax.jit(make_train_step(cfg), donate_argnums=0)
+        for _ in range(3):
+            state, m = step(state, batch)
+        lam1 = np.asarray(state.params["mixup_lambda"])
+        assert not np.allclose(lam0, lam1), "attn map must actually train"
+        # per-pixel training: updates differ across spatial positions of a
+        # single sample (a scalar-lambda degeneration would move every
+        # pixel of a sample by the same amount)
+        delta = lam1[0] - lam0[0]
+        assert float(delta.std()) > 0.0, "update must vary across pixels"
+
     def test_resnet_eval_step(self):
         cfg, state, batch = _resnet_setup(mixup_mode="none")
         ev = jax.jit(make_eval_step(cfg))
         m = ev(state, batch)
         assert 0.0 <= float(m["correct"]) <= float(m["total"])
+
+    def test_eval_step_respects_valid_mask(self):
+        # padded eval batches: masked-out samples contribute to no metric,
+        # so a padded split scores identically to the unpadded one
+        cfg, state, batch = _resnet_setup(mixup_mode="none")
+        ev = jax.jit(make_eval_step(cfg))
+        full = ev(state, {**batch, "valid": jnp.ones((8,), jnp.float32)})
+        half_mask = jnp.asarray([1, 1, 1, 1, 0, 0, 0, 0], jnp.float32)
+        half = ev(state, {**batch, "valid": half_mask})
+        assert float(half["total"]) == 4.0
+        sub = ev(state, {"image": batch["image"][:4],
+                         "label": batch["label"][:4]})
+        assert float(half["correct"]) == float(sub["correct"])
+        np.testing.assert_allclose(float(half["loss_total"]),
+                                   float(sub["loss_total"]), rtol=1e-5)
+        assert float(full["total"]) == 8.0
 
     def test_transformer_train_and_eval(self):
         cfg = TrainConfig(model="transformer", batch_size=4, lr=1e-3,

@@ -13,12 +13,21 @@ Per-trial hyperparameters:
   * lr     — via optax.inject_hyperparams, so the learning rate lives in
              the (vmapped) optimizer state instead of a baked schedule;
   * alpha  — mixup Beta parameter, traced into jax.random.beta;
+  * gamma  — optional per-trial LR-decay factor: the NGD tuning pairing's
+             step schedule (decay by gamma every `decay_steps`,
+             optim/builder.py "step" / tuning/resnet50_tuning.py:435)
+             is computed per step in the scan body and written into the
+             injected hyperparams — a baked optax schedule would be one
+             shared closure, which is exactly what a per-trial grid can't
+             use;
   * seed   — independent PRNG stream per trial.
 
-Supported optimizers here: sgd | madgrad | mirror_madgrad (factories whose
-learning_rate argument inject_hyperparams can lift).  The NGD grid runs
-through tuning/sweep.py instead (its Fisher state depends on a baked
-update schedule).
+Supported optimizers: sgd | madgrad | mirror_madgrad | ngd.  NGD's
+Fisher state is a pure pytree (optim/ngd.py ScaleByNGDState), so it
+vmaps like any other leaf; its update-period gating reads the per-trial
+`t` scalar, which the trial axis carries too.  This makes the
+reference's flagship alpha x gamma NGD grid
+(tuning/resnet50_tuning.sh:1-11) runnable as ONE compiled program.
 """
 
 from __future__ import annotations
@@ -38,6 +47,7 @@ from faster_distributed_training_tpu.config import TrainConfig
 from faster_distributed_training_tpu.models import get_model
 from faster_distributed_training_tpu.optim.madgrad import (madgrad,
                                                            mirror_madgrad)
+from faster_distributed_training_tpu.optim.ngd import ngd
 from faster_distributed_training_tpu.train import mixup_data, mixup_criterion
 from faster_distributed_training_tpu.train.losses import cross_entropy
 
@@ -45,6 +55,9 @@ _FACTORIES = {
     "sgd": lambda lr: optax.sgd(lr, momentum=0.9),
     "madgrad": lambda lr: madgrad(lr),
     "mirror_madgrad": lambda lr: mirror_madgrad(lr),
+    # the reference tuning grid's optimizer (resnet50_tuning.sh --ngd):
+    # momentum matches the reference pairing; Fisher state vmaps per trial
+    "ngd": lambda lr: ngd(lr, momentum=0.9, use_ngd=True),
 }
 
 
@@ -61,7 +74,9 @@ def vmap_trials(cfg: TrainConfig,
                 optimizer: str = "sgd",
                 steps: Optional[int] = None,
                 mesh=None,
-                model=None) -> Dict[str, np.ndarray]:
+                model=None,
+                gammas: Optional[Iterable[float]] = None,
+                decay_steps: Optional[int] = None) -> Dict[str, np.ndarray]:
     """Train K=len(lrs) trials in one vmapped program; returns per-trial
     final loss / train accuracy arrays.
 
@@ -73,11 +88,18 @@ def vmap_trials(cfg: TrainConfig,
     (tests use a tiny CNN — vmapping a full ResNet multiplies its already
     large graph by K, which the single-core CPU compiler chews on for
     many minutes).
+    gammas (optional, length K): per-trial step-decay factor — the
+    effective LR at step s is lr * gamma^(s // decay_steps), the NGD
+    tuning pairing (optim/builder.py "step": decay every 2 epochs).
+    decay_steps defaults to 2 epochs' worth of steps.
     """
     lrs = jnp.asarray(list(lrs), jnp.float32)
     alphas = jnp.asarray(list(alphas), jnp.float32)
     K = lrs.shape[0]
     assert alphas.shape[0] == K, "lrs and alphas must have equal length"
+    gammas = (None if gammas is None
+              else jnp.asarray(list(gammas), jnp.float32))
+    assert gammas is None or gammas.shape[0] == K
 
     model = model if model is not None else get_model(cfg.model,
                                                       cfg.num_classes)
@@ -87,7 +109,10 @@ def vmap_trials(cfg: TrainConfig,
     y_all = jnp.asarray(y_all, jnp.int32)
     n = x_all.shape[0]
     bs = min(cfg.batch_size, n)
-    steps = steps or max(n // bs, 1) * cfg.epochs
+    steps_per_epoch = max(n // bs, 1)
+    steps = steps or steps_per_epoch * cfg.epochs
+    if decay_steps is None:
+        decay_steps = 2 * steps_per_epoch      # "step" pairing: every 2 epochs
 
     def init_trial(seed, lr):
         variables = model.init({"params": seed}, x_all[:1], train=False)
@@ -96,10 +121,15 @@ def vmap_trials(cfg: TrainConfig,
         return (variables["params"], variables.get("batch_stats", {}),
                 opt_state)
 
-    def trial_step(carry, inputs, alpha):
+    def trial_step(carry, inputs, alpha, lr_now):
         params, stats, opt_state, rng = carry
         xb, yb = inputs
         rng, k_mix, k_drop = jax.random.split(rng, 3)
+        if lr_now is not None:
+            # per-step scheduled LR written into the injected hyperparams
+            opt_state = opt_state._replace(
+                hyperparams={**opt_state.hyperparams,
+                             "learning_rate": lr_now})
 
         def loss_fn(p):
             xm, y_a, y_b, lam = mixup_data(k_mix, xb, yb, alpha)
@@ -129,9 +159,15 @@ def vmap_trials(cfg: TrainConfig,
             start = (step_idx * bs) % max(n - bs + 1, 1)
             xb = jax.lax.dynamic_slice_in_dim(x_all, start, bs)
             yb = jax.lax.dynamic_slice_in_dim(y_all, start, bs)
+            if gammas is not None:
+                lr_now = lrs * gammas ** (step_idx // decay_steps)
+                in_axes = (0, None, 0, 0)
+            else:
+                lr_now = None
+                in_axes = (0, None, 0, None)
             (params, stats, opt_state, rngs), (loss, acc) = jax.vmap(
-                trial_step, in_axes=(0, None, 0)
-            )((params, stats, opt_state, rngs), (xb, yb), alphas)
+                trial_step, in_axes=in_axes
+            )((params, stats, opt_state, rngs), (xb, yb), alphas, lr_now)
             return (params, stats, opt_state, rngs), (loss, acc)
 
         carry = (states[0], states[1], states[2], rngs)
@@ -163,6 +199,9 @@ def main(argv=None):
                    choices=sorted(_FACTORIES))
     p.add_argument("--lrs", default="0.01,0.05,0.1,0.2")
     p.add_argument("--alphas", default="0.2,0.2,0.2,0.2")
+    p.add_argument("--gammas", default="",
+                   help="per-trial LR step-decay factors (the reference "
+                        "NGD grid's gamma axis, resnet50_tuning.sh:2)")
     p.add_argument("--bs", type=int, default=64)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--device", default="auto")
@@ -175,14 +214,17 @@ def main(argv=None):
     setup_platform(cfg)
     lrs = [float(v) for v in args.lrs.split(",")]
     alphas = [float(v) for v in args.alphas.split(",")]
+    gammas = ([float(v) for v in args.gammas.split(",")]
+              if args.gammas else None)
     data = synthetic_cifar(n=1024)
     mesh = make_mesh(("dp",)) if args.mesh_trials else None
     out = vmap_trials(cfg, lrs, alphas, data, optimizer=args.optimizer,
-                      steps=args.steps, mesh=mesh)
-    print(f"{'lr':>8} {'alpha':>6} {'loss':>8} {'acc':>6}")
-    for lr, a, l, acc in zip(lrs, alphas, out["final_loss"],
-                             out["final_acc"]):
-        print(f"{lr:>8.4g} {a:>6.2f} {l:>8.4f} {acc:>6.3f}")
+                      steps=args.steps, mesh=mesh, gammas=gammas)
+    print(f"{'lr':>8} {'alpha':>6} {'gamma':>6} {'loss':>8} {'acc':>6}")
+    for i, (lr, a) in enumerate(zip(lrs, alphas)):
+        g = gammas[i] if gammas else float("nan")
+        print(f"{lr:>8.4g} {a:>6.2f} {g:>6.2f} "
+              f"{out['final_loss'][i]:>8.4f} {out['final_acc'][i]:>6.3f}")
 
 
 if __name__ == "__main__":
