@@ -118,26 +118,34 @@ def _resolve_tokenizer(data_dir: str, corpus_texts: Sequence[str]):
     hf = _load_hf_tokenizer()
     if hf is not None:
         return hf
-    vocab_path = find_bert_vocab(data_dir)
-    if vocab_path:
-        return WordPieceTokenizer.from_vocab_file(vocab_path)
-    cache = os.path.join(data_dir, "ag_news", "wordpiece_vocab.txt")
-    if os.path.isfile(cache):
-        return WordPieceTokenizer.from_vocab_file(cache)
-    memo = _corpus_tokenizers.get(os.path.abspath(data_dir))
-    if memo is not None:
-        return memo
+    if data_dir:
+        vocab_path = find_bert_vocab(data_dir)
+        if vocab_path:
+            return WordPieceTokenizer.from_vocab_file(vocab_path)
+    # the disk cache + in-process memo exist to make the CSV train and
+    # test splits (same data_dir, same corpus family) share ONE vocab;
+    # in-memory datasets (from_samples with no data_dir: tests,
+    # benchmarks, ad-hoc corpora) must NOT read or write it — a vocab
+    # trained on one corpus silently cripples tokenization of another
+    if data_dir:
+        cache = os.path.join(data_dir, "ag_news", "wordpiece_vocab.txt")
+        if os.path.isfile(cache):
+            return WordPieceTokenizer.from_vocab_file(cache)
+        memo = _corpus_tokenizers.get(os.path.abspath(data_dir))
+        if memo is not None:
+            return memo
     if corpus_texts:
         tk = WordPieceTokenizer(build_wordpiece_vocab(corpus_texts))
-        _corpus_tokenizers[os.path.abspath(data_dir)] = tk
-        try:
-            os.makedirs(os.path.dirname(cache), exist_ok=True)
-            tk.save_vocab(cache)
-        except OSError:
-            print(f"[data] warning: could not write {cache}; later "
-                  f"processes will rebuild the vocab from their own "
-                  f"split — keep data_dir writable for cross-process "
-                  f"train/eval vocab agreement")
+        if data_dir:
+            _corpus_tokenizers[os.path.abspath(data_dir)] = tk
+            try:
+                os.makedirs(os.path.dirname(cache), exist_ok=True)
+                tk.save_vocab(cache)
+            except OSError:
+                print(f"[data] warning: could not write {cache}; later "
+                      f"processes will rebuild the vocab from their own "
+                      f"split — keep data_dir writable for cross-process "
+                      f"train/eval vocab agreement")
         return tk
     return HashTokenizer()
 
@@ -184,7 +192,10 @@ class AGNewsDataset:
                      clean: bool = True) -> "AGNewsDataset":
         """Build a dataset from in-memory (text, label) pairs — the same
         pipeline (clean -> tokenize -> bucket) without a CSV on disk;
-        used by tests and the input-pipeline benchmark."""
+        used by tests and the input-pipeline benchmark.  data_dir="" (the
+        default) keeps the corpus-trained vocab in-memory only — an
+        ad-hoc corpus must never poison the on-disk vocab cache a real
+        dataset in that directory would load."""
         self = cls.__new__(cls)
         self.buckets = tuple(buckets)
         self.samples = [((clean_text(t) if clean else t), int(l))
@@ -192,7 +203,7 @@ class AGNewsDataset:
         self.tokenizer = tokenizer
         if self.tokenizer is None:
             self.tokenizer = _resolve_tokenizer(
-                data_dir or ".", [t for t, _ in self.samples])
+                data_dir, [t for t, _ in self.samples])
         return self
 
     def __len__(self) -> int:
@@ -204,6 +215,20 @@ class AGNewsDataset:
     def vocab_size(self) -> int:
         tk = self.tokenizer
         return getattr(tk, "vocab_size", 30522)
+
+    def _bucketed_native(self, tokens_full: np.ndarray, lens: np.ndarray,
+                         labels: np.ndarray, max_len: int
+                         ) -> Dict[str, np.ndarray]:
+        """Shared tail of both native encode paths: bucket the padded
+        [n, max_len] token matrix to the smallest fitting length and
+        derive the attention mask from the true lengths."""
+        L = bucket_length(int(lens.max()),
+                          [b for b in self.buckets if b <= max_len]
+                          or [max_len])
+        tokens = tokens_full[:, :L]
+        mask = (np.arange(L)[None, :] < lens[:, None]).astype(np.int32)
+        return {"tokens": tokens, "token_types": np.zeros_like(tokens),
+                "mask": mask, "label": labels}
 
     def encode_batch(self, indices: Sequence[int], max_len: int = 512
                      ) -> Dict[str, np.ndarray]:
@@ -222,15 +247,7 @@ class AGNewsDataset:
                     handle, texts, max_len, tk.cls_id, tk.sep_id,
                     tk.unk_id, tk.pad_token_id)
             if native is not None:
-                tokens_full, lens = native
-                L = bucket_length(int(lens.max()),
-                                  [b for b in self.buckets if b <= max_len]
-                                  or [max_len])
-                tokens = tokens_full[:, :L]
-                mask = (np.arange(L)[None, :] < lens[:, None]).astype(np.int32)
-                return {"tokens": tokens,
-                        "token_types": np.zeros_like(tokens),
-                        "mask": mask, "label": labels}
+                return self._bucketed_native(*native, labels, max_len)
             # non-ASCII text or no native lib: the generic Python path
             # below handles it (WordPieceTokenizer has the HF encode
             # signature)
@@ -241,15 +258,7 @@ class AGNewsDataset:
                 texts, max_len, tk.vocab_size, tk.pad_id, tk.cls_id,
                 tk.sep_id, tk._reserved)
             if native is not None:
-                tokens_full, lens = native
-                L = bucket_length(int(lens.max()),
-                                  [b for b in self.buckets if b <= max_len]
-                                  or [max_len])
-                tokens = tokens_full[:, :L]
-                mask = (np.arange(L)[None, :] < lens[:, None]).astype(np.int32)
-                return {"tokens": tokens,
-                        "token_types": np.zeros_like(tokens),
-                        "mask": mask, "label": labels}
+                return self._bucketed_native(*native, labels, max_len)
             encoded = [self.tokenizer.encode(t, max_len) for t in texts]
             pad_id = self.tokenizer.pad_id
         else:

@@ -10,13 +10,15 @@ TPU-first replacement for the reference's dense ScaledDotProduct
     (ops.attention.dropout_keep) — still no HBM probabilities.
   * backward — recompute-in-backward (the same memory trick as the
     reference's FusedConvBN, resnet.py:107-108): residuals are just
-    (q, k, v, mask, seed).  Three measured branches (_flash_bwd):
-    dense VJP when ~3 score-shaped fp32 transients fit the budget
-    (v5e, 6L d512 bs=64 L=512: full step 95 ms vs 163 ms blockwise);
-    beyond it, a Pallas backward KERNEL on TPU (softmax stats
-    recomputed per q-block, dk/dv accumulated across the sequential
-    grid — O(L·block) memory, kill-switch FDT_DISABLE_PALLAS_BWD=1);
-    the blockwise-scan VJP elsewhere.
+    (q, k, v, mask, seed).  On TPU the default is the Pallas backward
+    KERNEL (softmax stats recomputed per q-block, dk/dv accumulated
+    across the sequential grid — O(L·block) memory): measured faster
+    than BOTH XLA-derived VJPs at every size tried on v5e (L=512
+    B=64: 6.9 vs 10.2 ms dense-VJP; L=2048 B=4: 9.0 vs 11.3/14.3).
+    Kill-switch FDT_DISABLE_PALLAS_BWD=1 restores the measured
+    two-branch VJP policy (dense under a ~2 GB score budget —
+    overridable via FDT_DENSE_BWD_BUDGET_MB — blockwise scan beyond),
+    which is also the off-TPU path.
   * non-TPU backends (tests, CPU sim) use the blockwise path; set
     FDT_FORCE_PALLAS_INTERPRET=1 to exercise both kernels in
     interpreter mode on CPU.
@@ -306,18 +308,21 @@ def _flash_bwd(block_q, dropout_rate, res, g):
     scores_bytes = 4 * B * H * Lq * Lk
     # every branch regenerates the forward's dropout mask from
     # (seed, bh, q, k) indices — identical by construction (dropout_keep)
-    if 3 * scores_bytes <= _dense_bwd_budget_bytes():
+    if _use_pallas() and os.environ.get("FDT_DISABLE_PALLAS_BWD") != "1":
+        # On TPU the backward kernel wins at EVERY measured size, not
+        # just long context (v5e bf16 fwd+bwd, interleaved re-measure:
+        # L=2048 B=4 H=8: 9.0 ms vs 11.3 dense-VJP / 14.3 blockwise-VJP;
+        # L=512 B=64 H=8: 6.9 ms vs 10.2 dense-VJP) while keeping
+        # O(L·block) memory — so it is the default, not a branch.
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, key_bias, dropout_seed,
+                                       dropout_rate, block_q)(g)
+    elif 3 * scores_bytes <= _dense_bwd_budget_bytes():
         _, vjp = jax.vjp(
             lambda q_, k_, v_: dense_attention_reference(
                 q_, k_, v_, mask, dropout_rate=dropout_rate,
                 dropout_seed=dropout_seed),
             q, k, v)
         dq, dk, dv = vjp(g)
-    elif _use_pallas() and os.environ.get("FDT_DISABLE_PALLAS_BWD") != "1":
-        # long context on TPU: the Pallas backward kernel — recompute
-        # inside the kernel, O(L·block) memory, no XLA-derived VJP
-        dq, dk, dv = _flash_bwd_pallas(q, k, v, key_bias, dropout_seed,
-                                       dropout_rate, block_q)(g)
     else:
         # long context off-TPU: recompute-in-backward via the blockwise
         # formulation keeps peak memory O(L*block) at the price of the

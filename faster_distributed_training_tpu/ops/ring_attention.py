@@ -27,7 +27,7 @@ from jax import lax
 from jax.sharding import Mesh
 
 from faster_distributed_training_tpu.ops.attention import (
-    NEG_INF, dropout_keep, finalize, init_carry, mask_to_bias,
+    NEG_INF, bh_index, dropout_keep, finalize, init_carry, mask_to_bias,
     online_block_update)
 
 
@@ -62,9 +62,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     pos = jnp.arange(L, dtype=jnp.int32)
     if dropout_bh is None:
-        dropout_bh = (jnp.arange(B, dtype=jnp.int32)[:, None] * H
-                      + jnp.arange(H, dtype=jnp.int32)[None, :]
-                      )[:, :, None, None]
+        dropout_bh = bh_index(B, H)
     seed = (jnp.uint32(0) if dropout_seed is None
             else dropout_seed.astype(jnp.uint32))
 

@@ -32,7 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh
 
-from faster_distributed_training_tpu.ops.attention import blockwise_attention
+from faster_distributed_training_tpu.ops.attention import (bh_index,
+                                                           blockwise_attention)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -80,9 +81,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     bh_post = None
     if dropout_rate > 0.0:
         if dropout_bh is None:
-            dropout_bh = (jnp.arange(B, dtype=jnp.int32)[:, None] * H
-                          + jnp.arange(H, dtype=jnp.int32)[None, :]
-                          )[:, :, None, None]
+            dropout_bh = bh_index(B, H)
         j = lax.axis_index(axis_name)
         h_per = H // sp
         # this device's post-swap head slice of the global index table
