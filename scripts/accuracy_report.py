@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Summarize accuracy-evidence runs (epoch logs -> markdown table + PNGs).
+
+Parses the `epoch N: ... test_acc=X time=Ts` lines the Trainer prints,
+emits a per-run summary table and a combined test-accuracy-curve plot —
+the artifact ACCURACY.md embeds next to the reference's published curves
+(/root/reference/README.md:56-73, figures/*.png).
+
+Run: python scripts/accuracy_report.py /tmp/acc_runs/*.log [--plot out.png]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_LINE = re.compile(
+    r"epoch (\d+): train_loss=([-\d.]+) train_acc=([-\d.]+) "
+    r"test_loss=([-\d.]+) test_acc=([-\d.]+) time=([\d.]+)s")
+
+
+def parse(path: str):
+    rows = []
+    with open(path) as f:
+        for line in f:
+            m = _LINE.search(line)
+            if m:
+                rows.append(tuple(float(x) for x in m.groups()))
+    return rows
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logs", nargs="+")
+    p.add_argument("--plot", default="")
+    args = p.parse_args()
+
+    curves = {}
+    print(f"| run | epochs | best test acc | final test acc | "
+          f"epoch@90%best | median epoch s |")
+    print("|---|---|---|---|---|---|")
+    for path in args.logs:
+        name = os.path.splitext(os.path.basename(path))[0]
+        rows = parse(path)
+        if not rows:
+            continue
+        accs = [r[4] for r in rows]
+        times = sorted(r[5] for r in rows)
+        best = max(accs)
+        reach = next(i for i, a in enumerate(accs) if a >= 0.9 * best)
+        print(f"| {name} | {len(rows)} | {best:.4f} | {accs[-1]:.4f} "
+              f"| {reach} | {times[len(times) // 2]:.1f} |")
+        curves[name] = accs
+
+    if args.plot and curves:
+        try:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except ImportError:
+            return
+        for name, accs in sorted(curves.items()):
+            plt.plot(range(len(accs)), accs, label=name)
+        plt.xlabel("epoch")
+        plt.ylabel("test accuracy")
+        plt.legend()
+        plt.grid(True, alpha=0.3)
+        plt.savefig(args.plot, dpi=120, bbox_inches="tight")
+        print(f"plot -> {args.plot}")
+
+
+if __name__ == "__main__":
+    main()
