@@ -48,6 +48,10 @@ class TrainConfig:
     ngd_update_period: int = 4
     ngd_alpha: float = 4.0
     ngd_eta: float = 0.1
+    ngd_max_dim: int = 8192           # skip Fisher preconditioning on axes
+                                      # larger than this (vocab-sized
+                                      # embedding axes stall training;
+                                      # optim/ngd.py NGDHyperParams.max_dim)
 
     # -- precision --------------------------------------------------------
     precision: str = "bf16"           # bf16 | fp32 | fp16 (fp16 uses loss scaling)
@@ -134,6 +138,15 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--model", default=None, type=str)
     p.add_argument("--optimizer", default=d.optimizer, type=str,
                    help="override: sgd|madgrad|mirror_madgrad|ngd|adamw")
+    p.add_argument("--schedule", default=d.schedule,
+                   choices=["", "multistep", "cosine", "onecycle", "step",
+                            "constant"],
+                   help="LR schedule override ('' = the reference pairing "
+                        "for the chosen optimizer)")
+    p.add_argument("--ngd_max_dim", default=d.ngd_max_dim, type=int,
+                   help="skip NGD Fisher preconditioning on tensor axes "
+                        "larger than this (vocab-sized embedding axes "
+                        "violate the dense-gradient assumption)")
     p.add_argument("--device", default=d.device, choices=["auto", "tpu", "cpu"])
     p.add_argument("--precision", default=d.precision, choices=["bf16", "fp32", "fp16"])
     p.add_argument("--mesh", default="", type=str,
@@ -201,7 +214,9 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         meta_learning=args.meta_learning, mixup_mode=args.mixup_mode,
         distributed=args.distributed, use_ngd=args.ngd,
         weight_decay=args.weight_decay, gamma=args.gamma,
-        optimizer=args.optimizer, device=args.device, precision=args.precision,
+        optimizer=args.optimizer, schedule=args.schedule,
+        ngd_max_dim=args.ngd_max_dim,
+        device=args.device, precision=args.precision,
         fsdp=args.fsdp, zero1=args.zero1, host_offload=args.host_offload,
         remat=args.remat,
         data_dir=args.data_dir, subset_stride=args.subset_stride, seed=args.seed,

@@ -270,6 +270,18 @@ class Transformer(nn.Module):
                            deterministic=not train)(f)
             h = h + f
 
+        # Final LayerNorm before the pooler.  The reference carries this
+        # layer as dead code — both its definition and its application
+        # are commented out (transformer.py:45,68):
+        # without it, six pre-LN residual blocks leave h unnormalized,
+        # the pooler's tanh pre-activation reaches |x|~3.6 at d_model=512
+        # (measured), tanh saturates, and gradients into the entire
+        # encoder attenuate ~300x — the d512/6L model cannot learn even
+        # on an overfit batch.  Applying the norm is the standard pre-LN
+        # closing step and a deliberate, documented fix (same category
+        # as the eval-mixup and -1e-9 mask fixes above).
+        h = ln("ln_final")(h)
+
         # Pooler: tanh(dense(CLS)) (transformer.py:94-101)
         pooled = nn.tanh(nn.Dense(self.d_model, kernel_init=xavier_uniform,
                                   dtype=self.dtype,

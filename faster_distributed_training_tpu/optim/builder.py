@@ -45,7 +45,8 @@ def build_optimizer(cfg: TrainConfig, steps_per_epoch: int,
         tx = _ngd(schedule, momentum=cfg.momentum,
                       weight_decay=cfg.weight_decay, use_ngd=True,
                       alpha=cfg.ngd_alpha, rank=cfg.ngd_rank,
-                      update_period=cfg.ngd_update_period, eta=cfg.ngd_eta)
+                      update_period=cfg.ngd_update_period, eta=cfg.ngd_eta,
+                      max_dim=cfg.ngd_max_dim)
     elif name == "sgd":
         tx = _ngd(schedule, momentum=cfg.momentum,
                       weight_decay=cfg.weight_decay, use_ngd=False)

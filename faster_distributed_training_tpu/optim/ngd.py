@@ -63,6 +63,20 @@ class NGDHyperParams:
     rank: int = -1          # -1 → min((dim+1)//2, 80) per axis
     update_period: int = 4
     eta: float = 0.1
+    # Axes larger than max_dim are left unpreconditioned (identity).
+    # Kaldi's online NGD estimates a dim x dim inverse-Fisher from rank-N
+    # outer products of DENSE gradients; a vocab-sized embedding axis
+    # (30522) violates both assumptions — its per-step gradient touches
+    # only the ~batch.seq tokens present, and empirically preconditioning
+    # that axis STALLS transformer training entirely (loss flat at
+    # chance; measured: adamw learns the same task to 96% in 5 epochs,
+    # NGD with the vocab axis preconditioned stays at 25-32%, NGD with it
+    # skipped learns — see ACCURACY.md).  The reference never validated
+    # its NGD on the transformer (its published accuracy results are
+    # CNN-only, README.md:63 "mainly CNN"), so this policy has no
+    # reference analog to match; 8192 clears every dense layer axis
+    # (d_ff=1024, conv 2048) while excluding vocab-sized tables.
+    max_dim: int = 8192
 
 
 class OnlineNaturalGradientState(NamedTuple):
@@ -401,7 +415,7 @@ def _param_axis_states(p: jax.Array, hp: NGDHyperParams, dtype
     states = []
     for axis in range(p.ndim):
         dim = p.shape[axis]
-        if dim > 1:
+        if 1 < dim <= hp.max_dim:
             states.append(init_ng_state(dim, hp, dtype))
         else:
             states.append(None)
@@ -422,7 +436,7 @@ def _build_plan(shapes, hp: NGDHyperParams):
     for r in range(max_nd):
         groups: Dict[Tuple[int, int, int], list] = {}
         for i, shp in enumerate(shapes):
-            if len(shp) > r and shp[r] > 1:
+            if len(shp) > r and 1 < shp[r] <= hp.max_dim:
                 dim = int(shp[r])
                 n = int(np.prod(shp)) // dim
                 rank_ = _default_rank(dim, hp.rank)
@@ -433,7 +447,8 @@ def _build_plan(shapes, hp: NGDHyperParams):
 
 def scale_by_ngd(alpha: float = 4.0, rank: int = -1, update_period: int = 4,
                  eta: float = 0.1, precond_dtype=jnp.float32,
-                 grouped: bool = True) -> optax.GradientTransformation:
+                 grouped: bool = True,
+                 max_dim: int = 8192) -> optax.GradientTransformation:
     """The preconditioning stage of the reference's NGD.step
     (ngd_optimizer.py:481-491): per param, per axis with dim>1, apply the
     online natural gradient sequentially (axis 0, then 1, ...).
@@ -444,7 +459,7 @@ def scale_by_ngd(alpha: float = 4.0, rank: int = -1, update_period: int = 4,
     program-structure change: the math per state is identical (covered by
     an equivalence test against the ungrouped path)."""
     hp = NGDHyperParams(alpha=alpha, rank=rank, update_period=update_period,
-                        eta=eta)
+                        eta=eta, max_dim=max_dim)
 
     # -------------------- grouped (default) --------------------
     def grouped_init(params):
@@ -528,7 +543,8 @@ def ngd(learning_rate, momentum: float = 0.0, dampening: float = 0.0,
         use_ngd: bool = True, alpha: float = 4.0, rank: int = -1,
         update_period: int = 4, eta: float = 0.1,
         precond_dtype=jnp.float32,
-        grouped: bool = True) -> optax.GradientTransformation:
+        grouped: bool = True,
+        max_dim: int = 8192) -> optax.GradientTransformation:
     """Full NGD optimizer, matching NGD.step order (ngd_optimizer.py:452-508):
     weight decay → per-axis preconditioning → momentum/nesterov → -lr."""
     if nesterov and (momentum <= 0 or dampening != 0):
@@ -539,7 +555,8 @@ def ngd(learning_rate, momentum: float = 0.0, dampening: float = 0.0,
         chain.append(optax.add_decayed_weights(weight_decay))
     if use_ngd:
         chain.append(scale_by_ngd(alpha, rank, update_period, eta,
-                                  precond_dtype, grouped=grouped))
+                                  precond_dtype, grouped=grouped,
+                                  max_dim=max_dim))
     if momentum:
         # torch SGD momentum: buf = momentum*buf + (1-dampening)*g;
         # nesterov: d_p = g + momentum*buf — optax.trace matches.

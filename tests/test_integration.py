@@ -39,6 +39,19 @@ class TestEndToEnd:
         assert len(res2["history"]["train_loss"]) == 1  # epochs 2..3
         assert res2["best_acc"] >= res["best_acc"]
 
+    def test_transformer_actually_learns(self, tmp_path):
+        """Above-chance is not enough (the r1 suite's acc > 0.15 smoke
+        checks missed a scale-dependent non-learning bug: the missing
+        final LayerNorm saturated the pooler tanh).  A 4-layer d=128
+        transformer on the learnable synthetic task must reach well
+        above chance within 3 epochs with an adaptive optimizer."""
+        res = run_training(_base_cfg(
+            tmp_path, model="transformer", batch_size=32, epochs=3,
+            lr=1e-3, optimizer="adamw", subset_stride=2, seq_len=32,
+            n_layers=4, d_model=128, d_ff=256, n_heads=4, alpha=0.0,
+            num_classes=4))
+        assert max(res["history"]["test_acc"]) > 0.6, res["history"]
+
     def test_transformer_synthetic_via_main(self, tmp_path):
         res = main([
             "--model", "transformer", "--dataset", "synthetic",

@@ -114,3 +114,56 @@ class TestTransformer:
         assert isinstance(m, Transformer)
         with pytest.raises(ValueError):
             get_model("alexnet", 10)
+
+    def test_param_count_matches_torch_reference_plus_final_ln(self):
+        """Parity modulo ONE documented delta: we apply the final
+        LayerNorm the reference carries as dead code (definition AND
+        application commented out, transformer.py:45,68) — +2*d_model
+        params (scale+bias)."""
+        torch = pytest.importorskip("torch")
+        sys.path.insert(0, REFERENCE)
+        try:
+            import transformer as ref_transformer
+        except Exception as e:  # pragma: no cover
+            pytest.skip(f"reference not importable: {e}")
+        finally:
+            sys.path.pop(0)
+        kw = dict(n_class=4, vocab=500, n_layers=2, h=4, d_model=32,
+                  d_ff=64, d_hidden=64, maxlen=16)
+        ref = ref_transformer.Transformer(**kw)
+        ref_count = sum(p.numel() for p in ref.parameters())
+        model = Transformer(**kw)
+        x = jnp.ones((2, 8), jnp.int32)
+        variables = model.init(
+            {"params": jax.random.PRNGKey(0),
+             "dropout": jax.random.PRNGKey(1),
+             "mixup": jax.random.PRNGKey(2)}, x, train=False)
+        ours = sum(int(np.prod(np.shape(p)))
+                   for p in jax.tree.leaves(variables["params"]))
+        assert ours == ref_count + 2 * kw["d_model"], (ours, ref_count)
+
+    def test_deep_model_pooler_not_saturated(self):
+        """Regression for the scale-dependent non-learning bug: without
+        the final LayerNorm, six pre-LN residual blocks leave the
+        pooler's tanh pre-activation at |x|~3.6 for d_model=512 —
+        tanh saturates and encoder gradients attenuate ~300x, so the
+        real-size model's loss stays flat at chance.  With the norm the
+        pre-activation must stay O(1)."""
+        model = Transformer(n_class=4, vocab=1000, n_layers=6, h=8,
+                            d_model=512, d_ff=1024, d_hidden=1024,
+                            maxlen=64, attention_impl="dense",
+                            mlp_impl="fused", alpha=0.0)
+        x = jnp.asarray(np.random.default_rng(0).integers(
+            0, 1000, size=(4, 32)), jnp.int32)
+        variables = model.init(
+            {"params": jax.random.PRNGKey(0),
+             "dropout": jax.random.PRNGKey(1),
+             "mixup": jax.random.PRNGKey(2)}, x, train=False)
+        _, st = model.apply(variables, x, train=False,
+                            capture_intermediates=True,
+                            mutable=["intermediates"])
+        preact = st["intermediates"]["pooler"]["__call__"][0]
+        mean_abs = float(jnp.abs(preact).mean())
+        assert mean_abs < 1.5, (
+            f"pooler pre-tanh magnitude {mean_abs:.2f} — saturation "
+            f"regression (was ~3.6 without the final LayerNorm)")
