@@ -69,11 +69,12 @@ def timed_resnet(use_ngd: bool, bs: int, steps: int):
 
     enable_compilation_cache()
     mesh = make_mesh(("dp",))  # batch sharded over every visible chip
+    remat = os.environ.get("FDT_BENCH_REMAT") == "1"
     cfg = TrainConfig(model="resnet50", batch_size=bs, alpha=0.2,
                       use_ngd=use_ngd,
                       optimizer="ngd" if use_ngd else "sgd",
-                      precision="bf16", epochs=1)
-    model = resnet50(num_classes=10)
+                      precision="bf16", epochs=1, remat=remat)
+    model = resnet50(num_classes=10, remat=remat)
     rng = jax.random.PRNGKey(cfg.seed)
     sample = jnp.zeros((bs, 32, 32, 3), jnp.float32)
     tx, _ = build_optimizer(cfg, steps_per_epoch=steps)
