@@ -119,9 +119,18 @@ def _flash_core(q, k, v, key_bias, dropout_seed, block_q, dropout_rate):
                        dropout_rate)
 
 
+def _fwd_kernel_fits(block_q: int, lk: int) -> bool:
+    """Empirical envelope (see _FWD_KERNEL_MAX_LK) plus a tile-size
+    bound so large-but-fitting Lk shrinks the q-tile."""
+    return (lk <= _FWD_KERNEL_MAX_LK
+            and 3 * block_q * lk * 4 <= 6 * 1024 * 1024)
+
+
 def _flash_impl(q, k, v, key_bias, dropout_seed, block_q, dropout_rate):
     B, H, Lq, D = q.shape
-    if _use_pallas():
+    while block_q > 32 and not _fwd_kernel_fits(block_q, k.shape[2]):
+        block_q //= 2
+    if _use_pallas() and _fwd_kernel_fits(block_q, k.shape[2]):
         nq = lambda x: x.reshape(B * H, x.shape[2], x.shape[3])  # noqa: E731
         kb = (jnp.repeat(key_bias, H, axis=0)
               if key_bias is not None else None)
@@ -161,6 +170,32 @@ def _dense_bwd_budget_bytes() -> int:
     return _DENSE_BWD_BUDGET_BYTES
 
 
+# The kernels keep the whole K/V (and for the backward, the dk/dv
+# accumulators) VMEM-resident per (batch*head) grid cell, and Pallas
+# double-buffers every input/output block — so the envelope is set by
+# Lk, nearly independent of the q-tile.  Byte models underpredicted the
+# compiler's scoped-vmem accounting (observed 16.0-16.2 MB right at the
+# limit), so the caps below are EMPIRICAL, validated on v5e at D=64:
+# each cap compiles and runs; the next power of two OOMs scoped vmem.
+# Beyond them the blockwise formulations (O(L·block) in XLA) take over;
+# k-blocking the kernels (FlashAttention-2 style) is the known next step.
+_FWD_KERNEL_MAX_LK = 8192
+_BWD_KERNEL_MAX_LK = 4096
+
+
+def _bwd_block_q(lq: int, lk: int) -> int:
+    """q-tile for the backward kernel: ~6 fp32 score-shaped transients
+    live at once, so shrink the tile as Lk grows."""
+    for cand in (512, 256, 128, 64):
+        if 6 * cand * lk * 4 <= 6 * 1024 * 1024:
+            return min(cand, max(lq, 32))
+    return 64
+
+
+def _bwd_kernel_fits(lq: int, lk: int) -> bool:
+    return lk <= _BWD_KERNEL_MAX_LK
+
+
 def _flash_bwd_pallas(q, k, v, key_bias, dropout_seed, dropout_rate,
                       block_q):
     """Pallas backward kernel: dq/dk/dv with softmax stats RECOMPUTED
@@ -198,14 +233,10 @@ def _flash_bwd_pallas(q, k, v, key_bias, dropout_seed, dropout_rate,
             else jnp.uint32(0)).reshape(1, 1).astype(jnp.uint32)
 
     # backward holds ~4 score-shaped fp32 tiles (s/p, dpterm, ds, keep):
-    # budget the q-tile to ~2 MB per tile so the working set stays well
-    # under VMEM next to the resident K/V
-    bq = 128
-    for cand in (512, 256, 128):
-        if cand * Lk * 4 <= 2 * 1024 * 1024:
-            bq = cand
-            break
-    bq = min(bq, Lq)
+    # budget the q-tile so tiles + the resident K/V stay inside the
+    # ~16 MB scoped-VMEM limit (measured: bq=128 at Lk=8192 overflows
+    # by 192 KB).  _bwd_kernel_fits gates callers beyond the envelope.
+    bq = _bwd_block_q(Lq, Lk)
     nq = -(-Lq // bq)
     pad_q = nq * bq - Lq
 
@@ -308,12 +339,16 @@ def _flash_bwd(block_q, dropout_rate, res, g):
     scores_bytes = 4 * B * H * Lq * Lk
     # every branch regenerates the forward's dropout mask from
     # (seed, bh, q, k) indices — identical by construction (dropout_keep)
-    if _use_pallas() and os.environ.get("FDT_DISABLE_PALLAS_BWD") != "1":
-        # On TPU the backward kernel wins at EVERY measured size, not
-        # just long context (v5e bf16 fwd+bwd, interleaved re-measure:
+    if (_use_pallas() and os.environ.get("FDT_DISABLE_PALLAS_BWD") != "1"
+            and _bwd_kernel_fits(Lq, Lk)):
+        # On TPU the backward kernel wins at EVERY measured size within
+        # its VMEM envelope (v5e bf16 fwd+bwd, interleaved re-measure:
         # L=2048 B=4 H=8: 9.0 ms vs 11.3 dense-VJP / 14.3 blockwise-VJP;
         # L=512 B=64 H=8: 6.9 ms vs 10.2 dense-VJP) while keeping
         # O(L·block) memory — so it is the default, not a branch.
+        # Beyond the envelope (K/V no longer VMEM-resident, ~Lk > 8k at
+        # D=64) the blockwise-VJP branch below takes over; k-blocking
+        # the kernel itself is the known next step.
         dq, dk, dv = _flash_bwd_pallas(q, k, v, key_bias, dropout_seed,
                                        dropout_rate, block_q)(g)
     elif 3 * scores_bytes <= _dense_bwd_budget_bytes():
