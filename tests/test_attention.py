@@ -539,3 +539,41 @@ class TestPallasBackwardKernel:
             self._grads_kernel(q, k, v, rate=0.3, seed=seed,
                                monkeypatch=monkeypatch),
             self._grads_ref(q, k, v, rate=0.3, seed=seed))
+
+
+class TestKernelEnvelopeRouting:
+    """Beyond the Pallas kernels' empirical VMEM caps the policy must
+    route to the blockwise formulations and stay gradient-correct.
+    Exercised at small sizes by shrinking the caps."""
+
+    def test_beyond_envelope_falls_back_and_matches_dense(self, monkeypatch):
+        import importlib
+        fa = importlib.import_module(
+            "faster_distributed_training_tpu.ops.flash_attention")
+        monkeypatch.setattr(fa, "_FWD_KERNEL_MAX_LK", 16)
+        monkeypatch.setattr(fa, "_BWD_KERNEL_MAX_LK", 16)
+        monkeypatch.setattr(fa, "_DENSE_BWD_BUDGET_BYTES", 0)
+        os.environ["FDT_FORCE_PALLAS_INTERPRET"] = "1"
+        try:
+            q, k, v = _qkv(jax.random.PRNGKey(80), B=2, H=2, L=32, D=8)
+
+            def loss(q_, k_, v_):
+                return jnp.sum(fa.flash_attention(
+                    q_, k_, v_, dropout_rate=0.3,
+                    dropout_seed=jnp.uint32(5)) ** 2)
+
+            g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+            def loss_ref(q_, k_, v_):
+                return jnp.sum(dense_attention_reference(
+                    q_, k_, v_, dropout_rate=0.3,
+                    dropout_seed=jnp.uint32(5)) ** 2)
+
+            g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+            for name, a, b in zip("qkv", g, g_ref):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=1e-5,
+                                           err_msg=f"d{name} mismatch "
+                                                   f"on fallback path")
+        finally:
+            del os.environ["FDT_FORCE_PALLAS_INTERPRET"]
