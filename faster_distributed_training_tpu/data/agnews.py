@@ -35,18 +35,53 @@ _TAG_RE = re.compile(r"<[^>]+>")
 _URL_RE = re.compile(r"https?://\S+|www\.\S+")
 _TOKEN_RE = re.compile(r"[a-z0-9']+")
 
-# compact English stopword list (gensim-equivalent role,
-# transformer_test.py:95)
+# gensim's 337-word STOPWORDS list, vendored verbatim (the reference
+# filters with gensim.parsing.remove_stopwords, transformer_test.py:95;
+# gensim itself is not a dependency here).  The list is sklearn's
+# 318-word ENGLISH_STOP_WORDS plus gensim's 19 documented additions —
+# tests/test_data.py re-derives it from sklearn to pin exactness.
+# Must equal kStopwords in runtime/native/fdt_native.cc (parity test in
+# tests/test_runtime.py).
 STOPWORDS = frozenset("""
-a about above after again against all am an and any are as at be because
-been before being below between both but by can did do does doing down
-during each few for from further had has have having he her here hers
-him his how i if in into is it its just me more most my no nor not now
-of off on once only or other our out over own s same she should so some
-such t than that the their them then there these they this those through
-to too under until up very was we were what when where which while who
-whom why will with you your
+a about above across after afterwards again against all almost alone
+along already also although always am among amongst amoungst amount an
+and another any anyhow anyone anything anyway anywhere are around as at
+back be became because become becomes becoming been before beforehand
+behind being below beside besides between beyond bill both bottom but
+by call can cannot cant co computer con could couldnt cry de describe
+detail did didn do does doesn doing don done down due during each eg
+eight either eleven else elsewhere empty enough etc even ever every
+everyone everything everywhere except few fifteen fifty fill find fire
+first five for former formerly forty found four from front full further
+get give go had has hasnt have he hence her here hereafter hereby
+herein hereupon hers herself him himself his how however hundred i ie
+if in inc indeed interest into is it its itself just keep kg km last
+latter latterly least less ltd made make many may me meanwhile might
+mill mine more moreover most mostly move much must my myself name
+namely neither never nevertheless next nine no nobody none noone nor
+not nothing now nowhere of off often on once one only onto or other
+others otherwise our ours ourselves out over own part per perhaps
+please put quite rather re really regarding same say see seem seemed
+seeming seems serious several she should show side since sincere six
+sixty so some somehow someone something sometime sometimes somewhere
+still such system take ten than that the their them themselves then
+thence there thereafter thereby therefore therein thereupon these they
+thick thin third this those though three through throughout thru thus
+to together too top toward towards twelve twenty two un under unless
+until up upon us used using various very via was we well were what
+whatever when whence whenever where whereafter whereas whereby wherein
+whereupon wherever whether which while whither who whoever whole whom
+whose why will with within without would yet you your yours yourself
+yourselves
 """.split())
+
+
+def cleaner_fingerprint() -> str:
+    """Hash of the cleaning configuration (today: the stopword list).
+    The corpus-trained WordPiece vocab is built from clean_text output,
+    so a vocab cached under one cleaner version must not be reused by
+    another — the cache filename embeds this fingerprint."""
+    return format(zlib.crc32(" ".join(sorted(STOPWORDS)).encode()), "08x")
 
 
 def clean_text_py(text: str) -> str:
@@ -128,7 +163,8 @@ def _resolve_tokenizer(data_dir: str, corpus_texts: Sequence[str]):
     # benchmarks, ad-hoc corpora) must NOT read or write it — a vocab
     # trained on one corpus silently cripples tokenization of another
     if data_dir:
-        cache = os.path.join(data_dir, "ag_news", "wordpiece_vocab.txt")
+        cache = os.path.join(data_dir, "ag_news",
+                             f"wordpiece_vocab_{cleaner_fingerprint()}.txt")
         if os.path.isfile(cache):
             return WordPieceTokenizer.from_vocab_file(cache)
         memo = _corpus_tokenizers.get(os.path.abspath(data_dir))

@@ -30,6 +30,7 @@
 #include <cstdint>
 #include <cstring>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <unordered_map>
 #include <unordered_set>
@@ -61,21 +62,54 @@ uint32_t crc32_of(const uint8_t* data, size_t len) {
 }
 
 // ------------------------------------------------------------- stopwords
-// Must equal data/agnews.py STOPWORDS.
+// gensim's 337-word STOPWORDS, vendored verbatim (the reference filters
+// with gensim.parsing.remove_stopwords, transformer_test.py:95).
+// Must equal data/agnews.py STOPWORDS (parity: tests/test_runtime.py).
 const char* kStopwords[] = {
-    "a", "about", "above", "after", "again", "against", "all", "am", "an",
-    "and", "any", "are", "as", "at", "be", "because", "been", "before",
-    "being", "below", "between", "both", "but", "by", "can", "did", "do",
-    "does", "doing", "down", "during", "each", "few", "for", "from",
-    "further", "had", "has", "have", "having", "he", "her", "here", "hers",
-    "him", "his", "how", "i", "if", "in", "into", "is", "it", "its", "just",
-    "me", "more", "most", "my", "no", "nor", "not", "now", "of", "off", "on",
-    "once", "only", "or", "other", "our", "out", "over", "own", "s", "same",
-    "she", "should", "so", "some", "such", "t", "than", "that", "the",
-    "their", "them", "then", "there", "these", "they", "this", "those",
-    "through", "to", "too", "under", "until", "up", "very", "was", "we",
-    "were", "what", "when", "where", "which", "while", "who", "whom", "why",
-    "will", "with", "you", "your"};
+    "a", "about", "above", "across", "after", "afterwards", "again",
+    "against", "all", "almost", "alone", "along", "already", "also",
+    "although", "always", "am", "among", "amongst", "amoungst", "amount",
+    "an", "and", "another", "any", "anyhow", "anyone", "anything", "anyway",
+    "anywhere", "are", "around", "as", "at", "back", "be", "became",
+    "because", "become", "becomes", "becoming", "been", "before",
+    "beforehand", "behind", "being", "below", "beside", "besides", "between",
+    "beyond", "bill", "both", "bottom", "but", "by", "call", "can", "cannot",
+    "cant", "co", "computer", "con", "could", "couldnt", "cry", "de",
+    "describe", "detail", "did", "didn", "do", "does", "doesn", "doing",
+    "don", "done", "down", "due", "during", "each", "eg", "eight", "either",
+    "eleven", "else", "elsewhere", "empty", "enough", "etc", "even", "ever",
+    "every", "everyone", "everything", "everywhere", "except", "few",
+    "fifteen", "fifty", "fill", "find", "fire", "first", "five", "for",
+    "former", "formerly", "forty", "found", "four", "from", "front", "full",
+    "further", "get", "give", "go", "had", "has", "hasnt", "have", "he",
+    "hence", "her", "here", "hereafter", "hereby", "herein", "hereupon",
+    "hers", "herself", "him", "himself", "his", "how", "however", "hundred",
+    "i", "ie", "if", "in", "inc", "indeed", "interest", "into", "is", "it",
+    "its", "itself", "just", "keep", "kg", "km", "last", "latter", "latterly",
+    "least", "less", "ltd", "made", "make", "many", "may", "me", "meanwhile",
+    "might", "mill", "mine", "more", "moreover", "most", "mostly", "move",
+    "much", "must", "my", "myself", "name", "namely", "neither", "never",
+    "nevertheless", "next", "nine", "no", "nobody", "none", "noone", "nor",
+    "not", "nothing", "now", "nowhere", "of", "off", "often", "on", "once",
+    "one", "only", "onto", "or", "other", "others", "otherwise", "our",
+    "ours", "ourselves", "out", "over", "own", "part", "per", "perhaps",
+    "please", "put", "quite", "rather", "re", "really", "regarding", "same",
+    "say", "see", "seem", "seemed", "seeming", "seems", "serious", "several",
+    "she", "should", "show", "side", "since", "sincere", "six", "sixty", "so",
+    "some", "somehow", "someone", "something", "sometime", "sometimes",
+    "somewhere", "still", "such", "system", "take", "ten", "than", "that",
+    "the", "their", "them", "themselves", "then", "thence", "there",
+    "thereafter", "thereby", "therefore", "therein", "thereupon", "these",
+    "they", "thick", "thin", "third", "this", "those", "though", "three",
+    "through", "throughout", "thru", "thus", "to", "together", "too", "top",
+    "toward", "towards", "twelve", "twenty", "two", "un", "under", "unless",
+    "until", "up", "upon", "us", "used", "using", "various", "very", "via",
+    "was", "we", "well", "were", "what", "whatever", "when", "whence",
+    "whenever", "where", "whereafter", "whereas", "whereby", "wherein",
+    "whereupon", "wherever", "whether", "which", "while", "whither", "who",
+    "whoever", "whole", "whom", "whose", "why", "will", "with", "within",
+    "without", "would", "yet", "you", "your", "yours", "yourself",
+    "yourselves"};
 
 const std::unordered_set<std::string>& stopword_set() {
   static const std::unordered_set<std::string> set(
@@ -171,9 +205,20 @@ struct WpVocab {
   std::unordered_map<std::string, int32_t> map;
 };
 
+// Registration and handle lookup are mutex-guarded: two tokenizer
+// instances (e.g. train memoized + test from cache file) may register /
+// encode concurrently under --workers, and push_back can reallocate the
+// vector's element storage out from under a concurrent reader.  The
+// unique_ptr indirection keeps each WpVocab itself at a stable address,
+// so encode only needs the lock long enough to copy the pointer out.
 std::vector<std::unique_ptr<WpVocab>>& wp_registry() {
   static std::vector<std::unique_ptr<WpVocab>> reg;
   return reg;
+}
+
+std::mutex& wp_mutex() {
+  static std::mutex m;
+  return m;
 }
 
 constexpr int kWpMaxCharsPerWord = 100;  // HF WordpieceTokenizer default
@@ -278,6 +323,7 @@ int32_t fdt_wp_load(const char* data, int64_t len) {
       start = i + 1;
     }
   }
+  std::lock_guard<std::mutex> lock(wp_mutex());
   wp_registry().push_back(std::move(v));
   return static_cast<int32_t>(wp_registry().size()) - 1;
 }
@@ -294,10 +340,14 @@ int32_t fdt_wp_encode_batch(int32_t handle, const char** texts, int32_t n,
                             int32_t max_len, int32_t cls_id, int32_t sep_id,
                             int32_t unk_id, int32_t pad_id,
                             int32_t* out_tokens, int32_t* out_lens) {
-  if (handle < 0 ||
-      handle >= static_cast<int32_t>(wp_registry().size()) || max_len < 2)
-    return -1;
-  const WpVocab& v = *wp_registry()[handle];
+  if (handle < 0 || max_len < 2) return -1;
+  const WpVocab* vp = nullptr;
+  {
+    std::lock_guard<std::mutex> lock(wp_mutex());
+    if (handle >= static_cast<int32_t>(wp_registry().size())) return -1;
+    vp = wp_registry()[handle].get();  // stable address past the lock
+  }
+  const WpVocab& v = *vp;
   std::vector<int32_t> ids;
   std::string word;
   for (int32_t b = 0; b < n; ++b) {
@@ -334,6 +384,24 @@ int32_t fdt_wp_encode_batch(int32_t handle, const char** texts, int32_t n,
     for (; pos < max_len; ++pos) row[pos] = pad_id;
   }
   return 0;
+}
+
+// Dump the vendored stopword list, newline-joined, into `out`
+// (NUL-terminated).  Returns the written length, or -(needed+1) when
+// out_cap is too small.  Exists so tests can assert exact set equality
+// between kStopwords and data/agnews.py STOPWORDS instead of inferring
+// it from cleaner behavior.
+int64_t fdt_stopwords(char* out, int64_t out_cap) {
+  std::string joined;
+  for (const char* w : kStopwords) {
+    if (!joined.empty()) joined += '\n';
+    joined += w;
+  }
+  int64_t need = static_cast<int64_t>(joined.size());
+  if (need + 1 > out_cap) return -(need + 1);
+  std::memcpy(out, joined.data(), joined.size());
+  out[need] = '\0';
+  return need;
 }
 
 // Gather `n` rows of `row_bytes` each from `src` at `indices` into `dst`

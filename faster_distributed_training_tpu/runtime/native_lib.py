@@ -69,6 +69,8 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int32, ctypes.c_int64, ctypes.c_char_p]
             lib.fdt_wp_load.restype = ctypes.c_int32
             lib.fdt_wp_load.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+            lib.fdt_stopwords.restype = ctypes.c_int64
+            lib.fdt_stopwords.argtypes = [ctypes.c_char_p, ctypes.c_int64]
             lib.fdt_wp_encode_batch.restype = ctypes.c_int32
             lib.fdt_wp_encode_batch.argtypes = [
                 ctypes.c_int32, ctypes.POINTER(ctypes.c_char_p),
@@ -110,6 +112,24 @@ def clean_text(text: str) -> Optional[str]:
         if n < 0:
             return None
     return buf.raw[:n].decode("utf-8", "ignore")
+
+
+def stopwords() -> Optional[frozenset]:
+    """The native core's vendored stopword list; None when the library is
+    unavailable.  Used by tests to pin byte-parity with data/agnews.py."""
+    lib = load()
+    if lib is None:
+        return None
+    cap = 4096
+    buf = ctypes.create_string_buffer(cap)
+    n = lib.fdt_stopwords(buf, cap)
+    if n < 0:
+        cap = -int(n)
+        buf = ctypes.create_string_buffer(cap)
+        n = lib.fdt_stopwords(buf, cap)
+        if n < 0:
+            return None
+    return frozenset(buf.raw[:n].decode("utf-8").split("\n"))
 
 
 def encode_batch(texts: List[str], max_len: int, vocab_size: int,

@@ -156,6 +156,36 @@ class TestText:
         assert "the" not in s.split()       # stopword removed
         assert "falls" in s
 
+    def test_stopwords_are_gensims_337(self):
+        """STOPWORDS must be gensim's exact list (the reference filters
+        with gensim.parsing.remove_stopwords, transformer_test.py:95).
+        gensim is not importable here, but its list is documented as
+        sklearn's ENGLISH_STOP_WORDS (importable) plus 19 additions —
+        re-derive it and pin exact equality, not just size."""
+        from faster_distributed_training_tpu.data.agnews import STOPWORDS
+        sklearn_text = pytest.importorskip("sklearn.feature_extraction.text")
+        gensim_extras = {
+            "computer", "did", "didn", "does", "doesn", "doing", "don",
+            "just", "kg", "km", "make", "quite", "really", "regarding",
+            "say", "unless", "used", "using", "various"}
+        expected = frozenset(sklearn_text.ENGLISH_STOP_WORDS) | gensim_extras
+        assert len(expected) == 337
+        assert STOPWORDS == expected
+
+    def test_gensim_stopword_examples_removed(self):
+        # words the old 115-word list let through
+        s = clean_text("the company system became nevertheless profitable "
+                       "using eleven computers")
+        assert "system" not in s.split()
+        assert "became" not in s.split()
+        assert "nevertheless" not in s.split()
+        assert "using" not in s.split()
+        assert "eleven" not in s.split()
+        assert "profitable" in s.split()
+        assert "computers" in s.split()     # 'computer' is a stopword; the
+                                            # plural is not (exact-match
+                                            # filter, same as gensim's)
+
     def test_hash_tokenizer_deterministic(self):
         tk = HashTokenizer()
         a = tk.encode("hello world", 16)

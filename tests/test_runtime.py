@@ -44,6 +44,17 @@ class TestCleanText:
         assert clean_text(s) == clean_text_py(s)
 
 
+class TestStopwords:
+    def test_native_list_equals_python_list(self):
+        """kStopwords (fdt_native.cc) must be the SAME SET as
+        data/agnews.py STOPWORDS — asserted directly via the
+        fdt_stopwords export, not inferred from cleaner behavior."""
+        from faster_distributed_training_tpu.data.agnews import STOPWORDS
+        native = native_lib.stopwords()
+        assert native is not None
+        assert native == STOPWORDS
+
+
 class TestCrc32:
     def test_matches_zlib(self):
         for data in [b"", b"a", b"hello world", bytes(range(256)) * 7]:
