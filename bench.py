@@ -12,6 +12,17 @@ line now always carries the other tracked numbers (VERDICT r1 weak #3):
 NGD's step-time overhead vs SGD and both reference transformer configs
 (transformer_test.py:355-361: bs=256/seq=256 and bs=64/seq=512).
 
+Round-3 additions (VERDICT r2 #1/#2/#8): each transformer config also
+emits its ROOFLINE fields — analytic model FLOPs/step, achieved
+TFLOP/s, MFU vs the chip's bf16 peak (device_peak_tflops, overridable
+via FDT_PEAK_TFLOPS), compiled peak memory, and XLA's own
+bytes-accessed estimate — plus a bs=256/seq=512 capacity pair with and
+without --remat (the layer-checkpoint lever), and, when
+FDT_BENCH_ATTN=1, the long-context attention ladder
+(attn_fwdbwd_ms_L{2048,4096,8192,16384}, fwd+bwd flash kernels, token
+count held at 16k) so the driver records the kernel envelope
+round-over-round instead of trusting hand-run PARITY notes.
+
 Baseline: the reference publishes no absolute throughput (BASELINE.md).
 `vs_baseline` is value / FDT_BENCH_BASELINE (img/s/chip) when that env
 var is set; otherwise the constant 1.0 with "baseline_configured": false
@@ -107,10 +118,43 @@ def timed_resnet(use_ngd: bool, bs: int, steps: int):
         return time.monotonic() - t0, mem
 
 
-def timed_transformer(bs: int, seq: int, steps: int) -> float:
+def transformer_model_flops(bs: int, seq: int, n_layers: int = 6,
+                            d: int = 512, dff: int = 1024,
+                            d_hidden: int = 1024, n_class: int = 4) -> float:
+    """Analytic matmul FLOPs for one train step (fwd + bwd ≈ 3× fwd), the
+    standard MFU numerator.  Per token per layer fwd: QKV 2·d·3d, out
+    proj 2·d², FFN 2·2·d·dff, attention 2·2·L·d (QKᵀ + PV); per sentence:
+    pooler 2·d² + classifier 2·d·dh + 2·dh·ncls.  Embedding gathers do
+    no matmul FLOPs and are excluded (convention)."""
+    per_tok = n_layers * (6 * d * d + 2 * d * d + 4 * d * dff
+                          + 4 * seq * d)
+    per_sent = 2 * d * d + 2 * d * d_hidden + 2 * d_hidden * n_class
+    return 3.0 * (bs * seq * per_tok + bs * per_sent)
+
+
+def device_peak_tflops() -> tuple:
+    """(peak bf16 TFLOP/s for MFU, source). FDT_PEAK_TFLOPS overrides; else
+    a device_kind table; else a conservative v5e default."""
+    import jax
+    env = os.environ.get("FDT_PEAK_TFLOPS")
+    if env:
+        return float(env), "env"
+    kind = jax.devices()[0].device_kind.lower()
+    for pat, peak in (("v6e", 918.0), ("v6 lite", 918.0), ("v5p", 459.0),
+                      ("v5e", 197.0), ("v5 lite", 197.0), ("v4", 275.0),
+                      ("v3", 123.0)):
+        if pat in kind:
+            return peak, kind
+    return 197.0, f"default (device_kind={kind!r})"
+
+
+def timed_transformer(bs: int, seq: int, steps: int,
+                      remat: bool = False) -> dict:
     """One donating transformer train program (reference architecture:
     6L d512 h8 ff1024, bert vocab — transformer.py:12-35) on synthetic
-    tokens; NGD like the flagship AG News run.  Returns elapsed seconds."""
+    tokens; NGD like the flagship AG News run.  Returns a dict with
+    elapsed seconds plus the roofline fields: compiled peak memory and
+    XLA's own cost analysis (flops / bytes accessed) when exposed."""
     import jax
     import jax.numpy as jnp
 
@@ -123,12 +167,16 @@ def timed_transformer(bs: int, seq: int, steps: int) -> float:
         make_put_batch, shard_train_state)
     from faster_distributed_training_tpu.train import (create_train_state,
                                                        make_train_step)
+    from faster_distributed_training_tpu.utils.profiling import (
+        compiled_memory_bytes)
 
     enable_compilation_cache()
     mesh = make_mesh(("dp",))
+    opt = os.environ.get("FDT_BENCH_TF_OPT", "ngd")
     cfg = TrainConfig(model="transformer", dataset="agnews", num_classes=4,
-                      batch_size=bs, seq_len=seq, use_ngd=True,
-                      optimizer="ngd", precision="bf16", epochs=1)
+                      batch_size=bs, seq_len=seq, use_ngd=(opt == "ngd"),
+                      optimizer=opt, precision="bf16", epochs=1,
+                      remat=remat)
     model = build_model(cfg, vocab_size=30522, mesh=mesh)
     rng = jax.random.PRNGKey(cfg.seed)
     sample = jnp.zeros((bs, seq), jnp.int32)
@@ -147,15 +195,71 @@ def timed_transformer(bs: int, seq: int, steps: int) -> float:
             "label": rr.integers(0, 4, size=(bs,)).astype(np.int32),
         })
         step = jax.jit(make_train_step(cfg), donate_argnums=0)
-        state, metrics = step(state, batch)
-        for _ in range(11):
-            state, metrics = step(state, batch)
+        compiled = step.lower(state, batch).compile()
+        out = {"bs": bs, "seq": seq, "remat": remat}
+        mem = compiled_memory_bytes(compiled)
+        if mem:
+            out["compiled_peak_mem_bytes"] = int(mem)
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            if ca:
+                if ca.get("flops"):
+                    out["xla_flops_per_step"] = float(ca["flops"])
+                ba = ca.get("bytes accessed") or ca.get("bytes_accessed")
+                if ba:
+                    out["xla_bytes_accessed_per_step"] = float(ba)
+        except Exception:
+            pass
+        for _ in range(12):
+            state, metrics = compiled(state, batch)
         _fence(metrics)
         t0 = time.monotonic()
         for _ in range(steps):
-            state, metrics = step(state, batch)
+            state, metrics = compiled(state, batch)
         _fence(metrics)
-        return time.monotonic() - t0
+        out["elapsed"] = time.monotonic() - t0
+        return out
+
+
+def timed_attention_ladder(steps: int = 30) -> dict:
+    """Long-context single-chip ladder (VERDICT r2 #8: promoted from
+    PARITY prose into the bench JSON).  fwd+bwd flash attention, bf16,
+    D=64, H=8, token count held at 16k (B·L = 16384), padding mask —
+    the exact hand-run configuration behind PARITY.md's envelope row.
+    Returns {"attn_fwdbwd_ms_L{L}": ms, ...}."""
+    import jax
+    import jax.numpy as jnp
+
+    from faster_distributed_training_tpu.ops.flash_attention import (
+        flash_attention)
+
+    H, D, tokens = 8, 64, 16384
+    out = {}
+    for L in (2048, 4096, 8192, 16384):
+        B = max(tokens // L, 1)
+        rr = np.random.default_rng(L)
+        q, k, v = (jnp.asarray(rr.normal(size=(B, H, L, D)), jnp.bfloat16)
+                   for _ in range(3))
+        lens = rr.integers(L // 2, L + 1, size=(B,))
+        mask = jnp.asarray(
+            (np.arange(L)[None, :] < lens[:, None]).astype(np.int32))
+
+        def loss(q_, k_, v_):
+            return jnp.sum(
+                flash_attention(q_, k_, v_, mask=mask).astype(jnp.float32)
+                ** 2)
+
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        g = step(q, k, v)
+        jax.block_until_ready(g)
+        t0 = time.monotonic()
+        for _ in range(steps):
+            g = step(q, k, v)
+        jax.block_until_ready(g)
+        out[f"attn_fwdbwd_ms_L{L}"] = round(
+            (time.monotonic() - t0) / steps * 1e3, 2)
+    return out
 
 
 def _run_child(mode: str, timeout: int = 1800):
@@ -184,10 +288,13 @@ def main() -> None:
     if child == "resnet_sgd":
         print(json.dumps({"elapsed": timed_resnet(False, bs, steps)[0]}))
         return
-    if child.startswith("tf_"):
-        _, cbs, cseq = child.split("_")
-        print(json.dumps({"elapsed": timed_transformer(int(cbs), int(cseq),
-                                                       tf_steps)}))
+    if child.startswith(("tf_", "tfr_")):
+        tag, cbs, cseq = child.split("_")
+        print(json.dumps(timed_transformer(int(cbs), int(cseq), tf_steps,
+                                           remat=(tag == "tfr"))))
+        return
+    if child == "attn_ladder":
+        print(json.dumps(timed_attention_ladder()))
         return
 
     n_chips = max(jax.device_count(), 1)
@@ -212,12 +319,48 @@ def main() -> None:
         if sgd:
             record["ngd_overhead_pct"] = round(
                 (elapsed - sgd["elapsed"]) / sgd["elapsed"] * 100.0, 1)
-        for cbs, cseq in ((256, 256), (64, 512)):
-            res = _run_child(f"tf_{cbs}_{cseq}")
-            if res:
-                key = f"transformer_agnews_ex_per_sec_bs{cbs}_seq{cseq}"
-                record[key] = round(cbs * tf_steps / res["elapsed"] / n_chips,
-                                    1)
+        peak, peak_src = device_peak_tflops()
+        record["peak_tflops_assumed"] = peak
+        record["peak_tflops_source"] = peak_src
+        # Roofline fields (VERDICT r2 #1): model FLOPs per step (analytic
+        # matmul count), achieved TFLOP/s, MFU vs the chip's bf16 peak,
+        # plus XLA's own cost analysis and the compiled peak memory.
+        # tfr_256_512 is the remat capacity point (VERDICT r2 #2): the
+        # same config with layer checkpointing, showing the memory delta.
+        for tag, cbs, cseq in (("tf", 256, 256), ("tf", 64, 512),
+                               ("tf", 256, 512), ("tfr", 256, 512)):
+            res = _run_child(f"{tag}_{cbs}_{cseq}")
+            if not res:
+                continue
+            name = f"bs{cbs}_seq{cseq}" + ("_remat" if tag == "tfr" else "")
+            exs = cbs * tf_steps / res["elapsed"] / n_chips
+            if tag == "tf" and (cbs, cseq) in ((256, 256), (64, 512)):
+                # round-over-round tracked keys, unchanged names
+                record[f"transformer_agnews_ex_per_sec_{name}"] = round(exs, 1)
+            else:
+                record[f"transformer_ex_per_sec_{name}"] = round(exs, 1)
+            mf = transformer_model_flops(cbs, cseq)
+            step_s = res["elapsed"] / tf_steps
+            # per-chip: the step is sharded over all visible chips, so
+            # achieved TFLOP/s and MFU are divided by the chip count to
+            # compare against ONE chip's peak
+            tflops = mf / step_s / 1e12 / n_chips
+            record[f"transformer_{name}_model_tflops_per_step"] = round(
+                mf / 1e12, 3)
+            record[f"transformer_{name}_achieved_tflops_per_chip"] = round(
+                tflops, 1)
+            record[f"transformer_{name}_mfu_pct"] = round(
+                100.0 * tflops / peak, 1)
+            if "compiled_peak_mem_bytes" in res:
+                record[f"transformer_{name}_peak_mem_bytes"] = (
+                    res["compiled_peak_mem_bytes"])
+            if "xla_bytes_accessed_per_step" in res:
+                record[f"transformer_{name}_xla_gb_per_step"] = round(
+                    res["xla_bytes_accessed_per_step"] / 1e9, 2)
+        if os.environ.get("FDT_BENCH_ATTN") == "1":
+            ladder = _run_child("attn_ladder")
+            if ladder:
+                record.update(ladder)
     print(json.dumps(record))
 
 

@@ -88,7 +88,10 @@ class TrainConfig:
     # -- bookkeeping ------------------------------------------------------
     seed: int = 123456                # resnet50_test.py:728
     checkpoint_dir: str = "./checkpoint"
-    log_every: int = 50
+    log_every: int = 50               # live loss/acc/ex-s line every N steps
+                                      # (tqdm-descriptor observability,
+                                      # resnet50_test.py:560-566, at 1/N the
+                                      # sync cost; 0 disables)
     profile: bool = False
     plot: bool = True
 
@@ -164,6 +167,10 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--seed", default=d.seed, type=int)
     p.add_argument("--checkpoint_dir", default=d.checkpoint_dir, type=str)
     p.add_argument("--profile", action="store_true", help="capture a jax.profiler trace")
+    p.add_argument("--log_every", default=d.log_every, type=int,
+                   help="live loss/acc/throughput line every N train steps "
+                        "(0 disables; the reference's tqdm descriptors, "
+                        "resnet50_test.py:560-566, at 1/N the sync cost)")
     p.add_argument("--no_plot", action="store_true")
     p.add_argument("--auto_recover", action="store_true",
                    help="on a non-finite epoch loss, restore the last good "
@@ -221,6 +228,7 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         remat=args.remat,
         data_dir=args.data_dir, subset_stride=args.subset_stride, seed=args.seed,
         checkpoint_dir=args.checkpoint_dir, profile=args.profile,
+        log_every=args.log_every,
         plot=not args.no_plot,
         auto_recover=args.auto_recover, debug=args.debug,
         seq_len=args.seq_len, n_layers=args.n_layers, d_model=args.d_model,

@@ -45,6 +45,18 @@ def xavier_uniform(key, shape, dtype=jnp.float32):
     return nn.initializers.xavier_uniform()(key, shape, dtype)
 
 
+def qkv_xavier(key, shape, dtype=jnp.float32):
+    """Xavier bound for the fused (d_model, 3, h, d_k) QKV kernel computed
+    per projection: the fused kernel is three (d_model, d_model) Xavier
+    matrices laid side by side, so the bound is sqrt(6/(2*d_model)) — the
+    same number the reference's per-matrix init produces
+    (transformer.py:86-91), not the smaller bound flax's variance_scaling
+    would derive from the 4-d shape."""
+    d_model = shape[0]
+    bound = math.sqrt(3.0 / d_model)
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
 class TorchLayerNorm(nn.Module):
     """The reference's hand-rolled LayerNorm (transformer.py:230-242):
     (x - mean) / (std + eps) with *unbiased* std and eps added to std."""
@@ -113,7 +125,14 @@ def dense_attention(q, k, v, mask, dropout_rate, deterministic, dropout_rng):
 
 
 class MultiheadAttention(nn.Module):
-    """transformer.py:196-227 — 3 full-width projections + output proj.
+    """transformer.py:196-227 — QKV projection + output proj.
+
+    The reference runs Q, K, V as three separate full-width nn.Linear
+    calls; here they are ONE fused (d_model → 3·d_model) matmul
+    (`qkv` DenseGeneral): one MXU dispatch and one HBM read of the
+    activations instead of three, with identical math and parameter
+    count.  The kernel is laid out (d_model, 3, h, d_k) so tensor
+    parallelism can shard the head axis (parallel/sharding._TP_RULES).
 
     attention_impl selects the context computation:
       dense — O(L²) ScaledDotProduct with prob dropout (the reference);
@@ -142,12 +161,13 @@ class MultiheadAttention(nn.Module):
                  train: bool) -> jax.Array:
         B, L, _ = x.shape
         d_k = self.d_model // self.h
-        dense = lambda name: nn.Dense(   # noqa: E731
-            self.d_model, kernel_init=xavier_uniform, dtype=self.dtype,
-            param_dtype=self.param_dtype, name=name)
-        q = dense("query")(x).reshape(B, L, self.h, d_k).transpose(0, 2, 1, 3)
-        k = dense("key")(x).reshape(B, L, self.h, d_k).transpose(0, 2, 1, 3)
-        v = dense("value")(x).reshape(B, L, self.h, d_k).transpose(0, 2, 1, 3)
+        qkv = nn.DenseGeneral((3, self.h, d_k), axis=-1,
+                              kernel_init=qkv_xavier, dtype=self.dtype,
+                              param_dtype=self.param_dtype,
+                              name="qkv")(x)        # (B, L, 3, h, d_k)
+        q = qkv[:, :, 0].transpose(0, 2, 1, 3)      # (B, h, L, d_k)
+        k = qkv[:, :, 1].transpose(0, 2, 1, 3)
+        v = qkv[:, :, 2].transpose(0, 2, 1, 3)
         # training-path prob dropout for the never-materialized impls:
         # one fresh u32 hash seed per step from the dropout rng stream
         drop_rate = self.dropout if (self.dropout > 0 and train) else 0.0
@@ -182,7 +202,9 @@ class MultiheadAttention(nn.Module):
             ctx = dense_attention(q, k, v, mask, self.dropout,
                                   deterministic=not train, dropout_rng=rng)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, L, self.d_model)
-        return dense("out")(ctx)
+        return nn.Dense(self.d_model, kernel_init=xavier_uniform,
+                        dtype=self.dtype, param_dtype=self.param_dtype,
+                        name="out")(ctx)
 
 
 class PositionalWiseFFN(nn.Module):
@@ -201,6 +223,47 @@ class PositionalWiseFFN(nn.Module):
         h = nn.gelu(h, approximate=False)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         return nn.Dense(self.d_model, **kw)(h)
+
+
+class EncoderLayer(nn.Module):
+    """One pre-LN attention sublayer + one pre-LN FFN sublayer
+    (transformer.py:245-275).  Factored into its own module so
+    ``Transformer.remat`` can wrap it in ``nn.remat`` — backward then
+    recomputes the layer's activations instead of keeping them in HBM,
+    the capacity lever long sequences need."""
+    h: int
+    d_model: int
+    d_ff: int
+    dropout_connection_attention: float = 0.1
+    dropout_connection_ffn: float = 0.1
+    dropout_attention: float = 0.1
+    dropout_ffn: float = 0.1
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    attention_impl: str = "dense"
+    mesh: Optional[Any] = None
+    sp_axis: str = "sp"
+
+    @nn.compact
+    def __call__(self, h: jax.Array, mask: Optional[jax.Array],
+                 train: bool) -> jax.Array:
+        ln = lambda name: TorchLayerNorm(   # noqa: E731
+            dtype=self.dtype, param_dtype=self.param_dtype, name=name)
+        a = ln("ln_attn")(h)
+        a = MultiheadAttention(self.h, self.d_model, self.dropout_attention,
+                               self.dtype, self.param_dtype,
+                               self.attention_impl, self.mesh,
+                               self.sp_axis, name="attn")(a, mask, train)
+        a = nn.Dropout(self.dropout_connection_attention,
+                       deterministic=not train)(a)
+        h = h + a
+        f = ln("ln_ffn")(h)
+        f = PositionalWiseFFN(self.d_model, self.d_ff, self.dropout_ffn,
+                              self.dtype, self.param_dtype,
+                              name="ffn")(f, train)
+        f = nn.Dropout(self.dropout_connection_ffn,
+                       deterministic=not train)(f)
+        return h + f
 
 
 class Transformer(nn.Module):
@@ -248,27 +311,24 @@ class Transformer(nn.Module):
         if mask is not None and mask.ndim == 2:   # (B, L) padding mask
             mask = mask[:, None, None, :]          # broadcast over heads+query
 
+        # Each encoder layer is one EncoderLayer module; with remat=True the
+        # module is checkpointed (train is static arg 3) so backward
+        # recomputes per-layer activations — the same stance as
+        # ResNet.remat and the FusedConvBN/FusedMLP recompute backwards.
+        layer_cls = EncoderLayer
+        if self.remat:
+            layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
+        for i in range(self.n_layers):
+            h = layer_cls(self.h, self.d_model, self.d_ff,
+                          self.dropout_connection_attention,
+                          self.dropout_connection_ffn,
+                          self.dropout_attention, self.dropout_ffn,
+                          self.dtype, self.param_dtype,
+                          self.attention_impl, self.mesh, self.sp_axis,
+                          name=f"layer_{i}")(h, mask, train)
+
         ln = lambda name: TorchLayerNorm(   # noqa: E731
             dtype=self.dtype, param_dtype=self.param_dtype, name=name)
-        for i in range(self.n_layers):
-            # pre-LN attention sublayer (transformer.py:245-259)
-            a = ln(f"ln_attn_{i}")(h)
-            a = MultiheadAttention(self.h, self.d_model, self.dropout_attention,
-                                   self.dtype, self.param_dtype,
-                                   self.attention_impl, self.mesh,
-                                   self.sp_axis,
-                                   name=f"attn_{i}")(a, mask, train)
-            a = nn.Dropout(self.dropout_connection_attention,
-                           deterministic=not train)(a)
-            h = h + a
-            # pre-LN FFN sublayer (transformer.py:262-275)
-            f = ln(f"ln_ffn_{i}")(h)
-            f = PositionalWiseFFN(self.d_model, self.d_ff, self.dropout_ffn,
-                                  self.dtype, self.param_dtype,
-                                  name=f"ffn_{i}")(f, train)
-            f = nn.Dropout(self.dropout_connection_ffn,
-                           deterministic=not train)(f)
-            h = h + f
 
         # Final LayerNorm before the pooler.  The reference carries this
         # layer as dead code — both its definition and its application

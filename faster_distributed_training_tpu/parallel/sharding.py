@@ -84,8 +84,11 @@ def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
 
 _TP_RULES = (
     # attention projections: shard the head (output-feature) dim.
-    # Patterns match models/transformer.py param paths (attn_N/query/kernel …)
-    # plus common hf/flax spellings.
+    # Patterns match models/transformer.py param paths plus common
+    # hf/flax spellings.  The fused QKV kernel is (d_model, 3, h, d_k),
+    # its bias (3, h, d_k) — the head axis is the shardable one.
+    (r".*(attn|attention).*/qkv/kernel", P(None, None, "tp", None)),
+    (r".*(attn|attention).*/qkv/bias", P(None, "tp", None)),
     (r".*(attn|attention).*/(query|key|value)/kernel", P(None, "tp")),
     (r".*(attn|attention).*/(query|key|value)/bias", P("tp")),
     (r".*(attn|attention).*/out/kernel", P("tp", None)),

@@ -65,10 +65,19 @@ class Trainer:
         self.best_acc = 0.0
         self.recoveries = 0
 
-    def run_epoch(self, state: TrainState, loader: Iterable) -> tuple:
+    def run_epoch(self, state: TrainState, loader: Iterable,
+                  epoch: int = 0) -> tuple:
         acc = MetricAccumulator()
         t0 = time.monotonic()
         metrics = None
+        n = 0
+        last_t, last_n = t0, 0
+        # --log_every N: a live loss/accuracy/throughput line every N
+        # steps — the reference's tqdm descriptor observability
+        # (resnet50_test.py:560-566) at 1/N its sync cost (tqdm's
+        # .item() reads synced EVERY batch; here one device->host
+        # readback per N steps, 0 disables).
+        log_every = int(self.cfg.log_every or 0)
         # device_prefetch stages put_batch (H2D transfer + device-side
         # augmentation dispatch) ahead of the consuming step — the
         # pin_memory + non_blocking overlap (resnet50_test.py:522), TPU style
@@ -76,6 +85,21 @@ class Trainer:
                                      depth=self.cfg.prefetch_depth):
             state, metrics = self.train_step(state, batch)
             acc.add(metrics)
+            n += 1
+            if log_every and n % log_every == 0:
+                loss = float(metrics["loss"])
+                correct = metrics.get("correct")
+                total = metrics.get("total")
+                now = time.monotonic()
+                exs = ((n - last_n) * self.cfg.batch_size
+                       / max(now - last_t, 1e-9))
+                line = f"[epoch {epoch}] step {n}: loss={loss:.4f}"
+                if correct is not None and total is not None:
+                    tot = float(total)
+                    if tot:
+                        line += f" acc={float(correct) / tot:.4f}"
+                self.log(line + f" {exs:.0f} ex/s")
+                last_t, last_n = now, n
         if metrics is not None:
             # fence with a device->host readback: on some PJRT backends
             # block_until_ready returns at dispatch, not completion
@@ -126,8 +150,8 @@ class Trainer:
                                  start_epoch - 1, self.best_acc)
         epoch = start_epoch
         while epoch < cfg.epochs:
-            state, train_m, elapsed = self.run_epoch(state,
-                                                     train_loader(epoch))
+            state, train_m, elapsed = self.run_epoch(
+                state, train_loader(epoch), epoch)
             # Failure detection (a deliberate addition — the reference's
             # only recovery is manual re-launch with --resume, SURVEY.md
             # §5): a non-finite epoch loss means the run is poisoned; roll

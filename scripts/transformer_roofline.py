@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Transformer single-chip roofline exploration (VERDICT r2 #1).
+
+Measures the reference transformer configs plus diagnostic variants to
+attribute the step time: optimizer (NGD vs SGD), batch scaling, remat,
+and the fp32 embedding island.  Each variant runs in ITS OWN process
+(donating programs must not share a process on the axon backend —
+bench.py's process model) when invoked without arguments; with
+FDT_ROOFLINE_CHILD set it runs exactly one variant and prints one JSON
+line.
+
+Run on a QUIET chip (tunnel contention corrupts timings):
+    python scripts/transformer_roofline.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VARIANTS = {
+    # name: (bs, seq, opt, remat)
+    "ngd_256_256": (256, 256, "ngd", False),
+    "sgd_256_256": (256, 256, "sgd", False),
+    "adamw_256_256": (256, 256, "adamw", False),
+    "ngd_512_256": (512, 256, "ngd", False),
+    "ngd_64_512": (64, 512, "ngd", False),
+    "ngd_256_512": (256, 512, "ngd", False),
+    "ngd_256_512_remat": (256, 512, "ngd", True),
+}
+
+
+def run_variant(name: str) -> dict:
+    bs, seq, opt, remat = VARIANTS[name]
+    os.environ["FDT_BENCH_TF_OPT"] = opt
+    import bench
+    res = bench.timed_transformer(bs, seq, steps=20, remat=remat)
+    res["variant"] = name
+    res["ex_per_sec"] = round(bs * 20 / res["elapsed"], 1)
+    mf = bench.transformer_model_flops(bs, seq)
+    res["mfu_pct"] = round(
+        100.0 * mf / (res["elapsed"] / 20) / 1e12
+        / bench.device_peak_tflops()[0], 1)
+    return res
+
+
+def main() -> None:
+    child = os.environ.get("FDT_ROOFLINE_CHILD")
+    if child:
+        print(json.dumps(run_variant(child)))
+        return
+    for name in VARIANTS:
+        env = dict(os.environ, FDT_ROOFLINE_CHILD=name)
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             env=env, capture_output=True, text=True,
+                             timeout=2400)
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() \
+            else f'{{"variant": "{name}", "error": true}}'
+        print(line, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -542,38 +542,172 @@ class TestPallasBackwardKernel:
 
 
 class TestKernelEnvelopeRouting:
-    """Beyond the Pallas kernels' empirical VMEM caps the policy must
-    route to the blockwise formulations and stay gradient-correct.
-    Exercised at small sizes by shrinking the caps."""
+    """Beyond the monolithic Pallas kernels' empirical VMEM caps the
+    policy must route to the K-BLOCKED (FA-2-style) kernels — and, with
+    the Pallas backward disabled, to the blockwise XLA VJP — and stay
+    gradient-correct on every route.  Exercised at small sizes by
+    shrinking the caps."""
 
-    def test_beyond_envelope_falls_back_and_matches_dense(self, monkeypatch):
+    def _grads(self, q, k, v):
+        def loss(q_, k_, v_):
+            import importlib
+            fa = importlib.import_module(
+                "faster_distributed_training_tpu.ops.flash_attention")
+            return jnp.sum(fa.flash_attention(
+                q_, k_, v_, dropout_rate=0.3,
+                dropout_seed=jnp.uint32(5)) ** 2)
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    def _grads_ref(self, q, k, v):
+        def loss_ref(q_, k_, v_):
+            return jnp.sum(dense_attention_reference(
+                q_, k_, v_, dropout_rate=0.3,
+                dropout_seed=jnp.uint32(5)) ** 2)
+
+        return jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+
+    def test_beyond_envelope_routes_to_kblocked_and_matches_dense(
+            self, monkeypatch):
         import importlib
         fa = importlib.import_module(
             "faster_distributed_training_tpu.ops.flash_attention")
-        monkeypatch.setattr(fa, "_FWD_KERNEL_MAX_LK", 16)
-        monkeypatch.setattr(fa, "_BWD_KERNEL_MAX_LK", 16)
-        monkeypatch.setattr(fa, "_DENSE_BWD_BUDGET_BYTES", 0)
+        monkeypatch.setattr(fa, "_FWD_KERNEL_MAX_LK", 0)
+        monkeypatch.setattr(fa, "_BWD_KERNEL_MAX_LK", 0)
         os.environ["FDT_FORCE_PALLAS_INTERPRET"] = "1"
         try:
             q, k, v = _qkv(jax.random.PRNGKey(80), B=2, H=2, L=32, D=8)
-
-            def loss(q_, k_, v_):
-                return jnp.sum(fa.flash_attention(
-                    q_, k_, v_, dropout_rate=0.3,
-                    dropout_seed=jnp.uint32(5)) ** 2)
-
-            g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-
-            def loss_ref(q_, k_, v_):
-                return jnp.sum(dense_attention_reference(
-                    q_, k_, v_, dropout_rate=0.3,
-                    dropout_seed=jnp.uint32(5)) ** 2)
-
-            g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+            g = self._grads(q, k, v)
+            g_ref = self._grads_ref(q, k, v)
             for name, a, b in zip("qkv", g, g_ref):
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                            rtol=1e-4, atol=1e-5,
                                            err_msg=f"d{name} mismatch "
-                                                   f"on fallback path")
+                                                   f"on k-blocked path")
+        finally:
+            del os.environ["FDT_FORCE_PALLAS_INTERPRET"]
+
+    def test_bwd_disabled_beyond_envelope_falls_back_to_blockwise_vjp(
+            self, monkeypatch):
+        import importlib
+        fa = importlib.import_module(
+            "faster_distributed_training_tpu.ops.flash_attention")
+        monkeypatch.setattr(fa, "_FWD_KERNEL_MAX_LK", 0)
+        monkeypatch.setattr(fa, "_BWD_KERNEL_MAX_LK", 0)
+        monkeypatch.setattr(fa, "_DENSE_BWD_BUDGET_BYTES", 0)
+        os.environ["FDT_FORCE_PALLAS_INTERPRET"] = "1"
+        os.environ["FDT_DISABLE_PALLAS_BWD"] = "1"
+        try:
+            q, k, v = _qkv(jax.random.PRNGKey(81), B=2, H=2, L=32, D=8)
+            g = self._grads(q, k, v)
+            g_ref = self._grads_ref(q, k, v)
+            for name, a, b in zip("qkv", g, g_ref):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=1e-5,
+                                           err_msg=f"d{name} mismatch "
+                                                   f"on blockwise fallback")
+        finally:
+            del os.environ["FDT_FORCE_PALLAS_INTERPRET"]
+            del os.environ["FDT_DISABLE_PALLAS_BWD"]
+
+    def test_envelope_caps_scale_with_head_dim(self):
+        """ADVICE r2 (medium): the empirical Lk caps were validated at
+        D=64; K/V residency scales with D, so the fit checks must scale
+        the cap by 64/D — a D=128 model at the D=64 cap must NOT claim
+        to fit."""
+        import importlib
+        fa = importlib.import_module(
+            "faster_distributed_training_tpu.ops.flash_attention")
+        assert fa._bwd_kernel_fits(128, fa._BWD_KERNEL_MAX_LK, d=64)
+        assert not fa._bwd_kernel_fits(128, fa._BWD_KERNEL_MAX_LK, d=128)
+        assert fa._bwd_kernel_fits(128, fa._BWD_KERNEL_MAX_LK // 2, d=128)
+        # q-tile 32: small enough that only the Lk·D envelope decides
+        assert fa._fwd_kernel_fits(32, fa._FWD_KERNEL_MAX_LK, d=64)
+        assert not fa._fwd_kernel_fits(32, fa._FWD_KERNEL_MAX_LK, d=128)
+
+    def test_bwd_block_q_is_sublane_aligned(self):
+        """ADVICE r2 (low): odd Lq must not yield an odd q-tile —
+        Mosaic sublane tiling wants multiples of 8 (padding handles
+        Lq % bq)."""
+        import importlib
+        fa = importlib.import_module(
+            "faster_distributed_training_tpu.ops.flash_attention")
+        for lq in (100, 33, 7, 512):
+            assert fa._bwd_block_q(lq, 4096) % 8 == 0, lq
+
+
+class TestKBlockedKernels:
+    """The k-blocked (FA-2-style) kernels must match the dense reference
+    in forward, lse, and gradients — including padding masks, ragged
+    tiles, and dropout — in interpret mode (hardware-checked separately
+    on the real chip)."""
+
+    def _setup(self, key, B=2, H=2, L=48, D=16, masked=True):
+        q, k, v = _qkv(key, B=B, H=H, L=L, D=D)
+        mask = (_padding_mask(jax.random.PRNGKey(7), B=B, L=L)
+                if masked else None)
+        return q, k, v, mask
+
+    def _force_kblocked(self, monkeypatch):
+        import importlib
+        fa = importlib.import_module(
+            "faster_distributed_training_tpu.ops.flash_attention")
+        monkeypatch.setattr(fa, "_FWD_KERNEL_MAX_LK", 0)
+        monkeypatch.setattr(fa, "_BWD_KERNEL_MAX_LK", 0)
+        return fa
+
+    def test_forward_and_lse_match_dense(self, monkeypatch):
+        fa = self._force_kblocked(monkeypatch)
+        os.environ["FDT_FORCE_PALLAS_INTERPRET"] = "1"
+        try:
+            q, k, v, mask = self._setup(jax.random.PRNGKey(90))
+            B, H, L, D = q.shape
+            out = fa.flash_attention(q, k, v, mask=mask)
+            ref = dense_attention_reference(q, k, v,
+                                            mask[:, None, None, :])
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5)
+            # direct kernel call: lse must equal logsumexp of the
+            # masked scaled scores
+            from faster_distributed_training_tpu.ops.attention import (
+                mask_to_bias)
+            n3 = lambda x: x.reshape(B * H, L, D)  # noqa: E731
+            kb = jnp.repeat(mask_to_bias(mask.astype(jnp.float32)), H,
+                            axis=0)
+            o2, lse = fa._flash_fwd_kblocked(n3(q), n3(k), n3(v), kb)
+            s = (jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+                 + jnp.where(mask[:, None, None, :] == 0, -1e9, 0.0))
+            lse_ref = jax.nn.logsumexp(s, axis=-1).reshape(B * H, L)
+            np.testing.assert_allclose(np.asarray(lse),
+                                       np.asarray(lse_ref),
+                                       rtol=1e-5, atol=1e-5)
+        finally:
+            del os.environ["FDT_FORCE_PALLAS_INTERPRET"]
+
+    def test_grads_match_dense_with_mask_ragged_and_dropout(
+            self, monkeypatch):
+        fa = self._force_kblocked(monkeypatch)
+        os.environ["FDT_FORCE_PALLAS_INTERPRET"] = "1"
+        try:
+            # L=44 -> ragged q and k tiles after 8/128-multiple padding
+            q, k, v, mask = self._setup(jax.random.PRNGKey(91), L=44)
+            seed = jnp.uint32(17)
+
+            def loss(q_, k_, v_):
+                return jnp.sum(fa.flash_attention(
+                    q_, k_, v_, mask=mask, dropout_rate=0.3,
+                    dropout_seed=seed) ** 2)
+
+            def loss_ref(q_, k_, v_):
+                return jnp.sum(dense_attention_reference(
+                    q_, k_, v_, mask[:, None, None, :], dropout_rate=0.3,
+                    dropout_seed=seed) ** 2)
+
+            g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+            for name, a, b in zip("qkv", g, g_ref):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=1e-5,
+                                           err_msg=f"d{name} mismatch")
         finally:
             del os.environ["FDT_FORCE_PALLAS_INTERPRET"]

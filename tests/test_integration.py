@@ -44,12 +44,21 @@ class TestEndToEnd:
         checks missed a scale-dependent non-learning bug: the missing
         final LayerNorm saturated the pooler tanh).  A 4-layer d=128
         transformer on the learnable synthetic task must reach well
-        above chance within 3 epochs with an adaptive optimizer."""
+        above chance within 3 epochs with an adaptive optimizer.  The
+        schedule is pinned constant: the transformer default (onecycle)
+        spends most of a 3-epoch run warming up, which made the takeoff
+        epoch sensitive to the init stream — this test is about
+        learnability, not the schedule (which has its own tests)."""
         res = run_training(_base_cfg(
             tmp_path, model="transformer", batch_size=32, epochs=3,
-            lr=1e-3, optimizer="adamw", subset_stride=2, seq_len=32,
+            lr=2e-3, optimizer="adamw", schedule="constant",
+            subset_stride=1, seq_len=32,
             n_layers=4, d_model=128, d_ff=256, n_heads=4, alpha=0.0,
             num_classes=4))
+        # measured margin under the suite's exact flags (x64 on):
+        # stride=1 + constant 2e-3 reaches 0.98 by epoch 3; the previous
+        # stride-2/96-step budget put the pass/fail line inside normal
+        # init-stream trajectory variance
         assert max(res["history"]["test_acc"]) > 0.6, res["history"]
 
     def test_transformer_synthetic_via_main(self, tmp_path):

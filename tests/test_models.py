@@ -142,6 +142,56 @@ class TestTransformer:
                    for p in jax.tree.leaves(variables["params"]))
         assert ours == ref_count + 2 * kw["d_model"], (ours, ref_count)
 
+    def test_remat_gradients_match_no_remat(self):
+        """--remat must be a pure memory/compute trade: forward values and
+        parameter gradients identical with and without layer checkpointing
+        (regression for the round-2 dead flag — Transformer.remat was
+        declared and CLI-passed but never wired)."""
+        kw = dict(n_class=4, vocab=64, n_layers=2, h=4, d_model=32,
+                  d_ff=64, d_hidden=64, maxlen=16, alpha=0.0)
+        x = jnp.asarray(np.random.default_rng(3).integers(
+            0, 64, size=(4, 12)), jnp.int32)
+        y = jnp.asarray([0, 1, 2, 3], jnp.int32)
+        rngs = {"params": jax.random.PRNGKey(0),
+                "dropout": jax.random.PRNGKey(1),
+                "mixup": jax.random.PRNGKey(2)}
+        base = Transformer(**kw, remat=False)
+        variables = base.init(rngs, x, train=False)
+
+        def loss_fn(params, model):
+            logits, _, _ = model.apply(
+                {"params": params}, x, train=True,
+                rngs={"dropout": jax.random.PRNGKey(5),
+                      "mixup": jax.random.PRNGKey(6)})
+            onehot = jax.nn.one_hot(y, 4)
+            return -jnp.mean(jnp.sum(
+                jax.nn.log_softmax(logits) * onehot, axis=-1))
+
+        l0, g0 = jax.value_and_grad(loss_fn)(variables["params"], base)
+        l1, g1 = jax.value_and_grad(loss_fn)(
+            variables["params"], Transformer(**kw, remat=True))
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        for p0, p1 in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(p0), np.asarray(p1),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_fused_qkv_param_layout_and_tp_rule(self, small):
+        """The fused QKV kernel is (d_model, 3, h, d_k) and the TP name
+        rules shard its head axis."""
+        from jax.sharding import PartitionSpec as P
+
+        from faster_distributed_training_tpu.parallel.sharding import (
+            tensor_parallel_rules)
+        model, variables, _ = small
+        qkv = variables["params"]["layer_0"]["attn"]["qkv"]
+        d_k = model.d_model // model.h
+        assert qkv["kernel"].shape == (model.d_model, 3, model.h, d_k)
+        assert qkv["bias"].shape == (3, model.h, d_k)
+        assert (tensor_parallel_rules("model/layer_0/attn/qkv/kernel")
+                == P(None, None, "tp", None))
+        assert (tensor_parallel_rules("model/layer_0/attn/qkv/bias")
+                == P(None, "tp", None))
+
     def test_deep_model_pooler_not_saturated(self):
         """Regression for the scale-dependent non-learning bug: without
         the final LayerNorm, six pre-LN residual blocks leave the
