@@ -176,7 +176,9 @@ def timed_transformer(bs: int, seq: int, steps: int,
     cfg = TrainConfig(model="transformer", dataset="agnews", num_classes=4,
                       batch_size=bs, seq_len=seq, use_ngd=(opt == "ngd"),
                       optimizer=opt, precision="bf16", epochs=1,
-                      remat=remat)
+                      remat=remat,
+                      attention=os.environ.get("FDT_BENCH_TF_ATTN", ""),
+                      mlp_impl=os.environ.get("FDT_BENCH_TF_MLP", ""))
     model = build_model(cfg, vocab_size=30522, mesh=mesh)
     rng = jax.random.PRNGKey(cfg.seed)
     sample = jnp.zeros((bs, seq), jnp.int32)

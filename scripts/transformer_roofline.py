@@ -23,7 +23,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 VARIANTS = {
-    # name: (bs, seq, opt, remat)
+    # name: (bs, seq, opt, remat[, attention, mlp_impl])
     "ngd_256_256": (256, 256, "ngd", False),
     "sgd_256_256": (256, 256, "sgd", False),
     "adamw_256_256": (256, 256, "adamw", False),
@@ -31,12 +31,22 @@ VARIANTS = {
     "ngd_64_512": (64, 512, "ngd", False),
     "ngd_256_512": (256, 512, "ngd", False),
     "ngd_256_512_remat": (256, 512, "ngd", True),
+    # impl attribution: XLA dense attention / XLA fused MLP vs the
+    # Pallas defaults at the short reference lengths
+    "sgd_256_256_dense": (256, 256, "sgd", False, "dense", ""),
+    "sgd_256_256_xla_mlp": (256, 256, "sgd", False, "", "fused"),
+    "sgd_256_256_dense_xla_mlp": (256, 256, "sgd", False, "dense", "fused"),
+    "sgd_64_512_dense": (64, 512, "sgd", False, "dense", ""),
 }
 
 
 def run_variant(name: str) -> dict:
-    bs, seq, opt, remat = VARIANTS[name]
+    bs, seq, opt, remat = VARIANTS[name][:4]
+    extra = VARIANTS[name][4:]
     os.environ["FDT_BENCH_TF_OPT"] = opt
+    if extra:
+        os.environ["FDT_BENCH_TF_ATTN"] = extra[0]
+        os.environ["FDT_BENCH_TF_MLP"] = extra[1]
     import bench
     res = bench.timed_transformer(bs, seq, steps=20, remat=remat)
     res["variant"] = name

