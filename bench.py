@@ -17,11 +17,11 @@ emits its ROOFLINE fields — analytic model FLOPs/step, achieved
 TFLOP/s, MFU vs the chip's bf16 peak (device_peak_tflops, overridable
 via FDT_PEAK_TFLOPS), compiled peak memory, and XLA's own
 bytes-accessed estimate — plus a bs=256/seq=512 capacity pair with and
-without --remat (the layer-checkpoint lever), and, when
-FDT_BENCH_ATTN=1, the long-context attention ladder
-(attn_fwdbwd_ms_L{2048,4096,8192,16384}, fwd+bwd flash kernels, token
-count held at 16k) so the driver records the kernel envelope
-round-over-round instead of trusting hand-run PARITY notes.
+without --remat (the layer-checkpoint lever), and the long-context
+attention ladder (attn_fwdbwd_ms_L{2048,4096,8192,16384}, fwd+bwd flash
+kernels, token count held at 16k) so the driver records the kernel
+envelope round-over-round instead of trusting hand-run PARITY notes
+(default-on since round 4, VERDICT r3 #4; FDT_BENCH_ATTN=0 disables).
 
 Baseline: the reference publishes no absolute throughput (BASELINE.md).
 `vs_baseline` is value / FDT_BENCH_BASELINE (img/s/chip) when that env
@@ -66,9 +66,10 @@ def timed_resnet(use_ngd: bool, bs: int, steps: int):
     import jax
     import jax.numpy as jnp
 
-    from faster_distributed_training_tpu.cli import enable_compilation_cache
-    from faster_distributed_training_tpu.config import TrainConfig
-    from faster_distributed_training_tpu.models import resnet50
+    from faster_distributed_training_tpu.cli import (build_model,
+                                                     enable_compilation_cache)
+    from faster_distributed_training_tpu.config import (TrainConfig,
+                                                        resolve_tricks)
     from faster_distributed_training_tpu.optim import build_optimizer
     from faster_distributed_training_tpu.parallel import make_mesh
     from faster_distributed_training_tpu.parallel.placement import (
@@ -81,11 +82,13 @@ def timed_resnet(use_ngd: bool, bs: int, steps: int):
     enable_compilation_cache()
     mesh = make_mesh(("dp",))  # batch sharded over every visible chip
     remat = os.environ.get("FDT_BENCH_REMAT") == "1"
-    cfg = TrainConfig(model="resnet50", batch_size=bs, alpha=0.2,
-                      use_ngd=use_ngd,
-                      optimizer="ngd" if use_ngd else "sgd",
-                      precision="bf16", epochs=1, remat=remat)
-    model = resnet50(num_classes=10, remat=remat)
+    cfg = resolve_tricks(TrainConfig(
+        model="resnet50", batch_size=bs, alpha=0.2, use_ngd=use_ngd,
+        optimizer="ngd" if use_ngd else "sgd",
+        precision="bf16", epochs=1, remat=remat,
+        tricks=os.environ.get("FDT_BENCH_TRICKS", "") or "on"))
+    # build_model so dtype/conv_remat follow cfg (the CLI's real path)
+    model = build_model(cfg)
     rng = jax.random.PRNGKey(cfg.seed)
     sample = jnp.zeros((bs, 32, 32, 3), jnp.float32)
     tx, _ = build_optimizer(cfg, steps_per_epoch=steps)
@@ -173,12 +176,18 @@ def timed_transformer(bs: int, seq: int, steps: int,
     enable_compilation_cache()
     mesh = make_mesh(("dp",))
     opt = os.environ.get("FDT_BENCH_TF_OPT", "ngd")
-    cfg = TrainConfig(model="transformer", dataset="agnews", num_classes=4,
-                      batch_size=bs, seq_len=seq, use_ngd=(opt == "ngd"),
-                      optimizer=opt, precision="bf16", epochs=1,
-                      remat=remat,
-                      attention=os.environ.get("FDT_BENCH_TF_ATTN", ""),
-                      mlp_impl=os.environ.get("FDT_BENCH_TF_MLP", ""))
+    from faster_distributed_training_tpu.config import resolve_tricks
+    cfg = resolve_tricks(TrainConfig(
+        model="transformer", dataset="agnews", num_classes=4,
+        batch_size=bs, seq_len=seq, use_ngd=(opt == "ngd"),
+        optimizer=opt, precision="bf16", epochs=1,
+        remat=remat,
+        remat_policy=os.environ.get("FDT_BENCH_TF_REMAT_POLICY",
+                                    "") or "attn_out",
+        attention=os.environ.get("FDT_BENCH_TF_ATTN", ""),
+        mlp_impl=os.environ.get("FDT_BENCH_TF_MLP", ""),
+        dropout_impl=os.environ.get("FDT_BENCH_TF_DROPOUT", "") or "hash",
+        tricks=os.environ.get("FDT_BENCH_TRICKS", "") or "on"))
     model = build_model(cfg, vocab_size=30522, mesh=mesh)
     rng = jax.random.PRNGKey(cfg.seed)
     sample = jnp.zeros((bs, seq), jnp.int32)
@@ -199,6 +208,8 @@ def timed_transformer(bs: int, seq: int, steps: int,
         step = jax.jit(make_train_step(cfg), donate_argnums=0)
         compiled = step.lower(state, batch).compile()
         out = {"bs": bs, "seq": seq, "remat": remat}
+        if remat:
+            out["remat_policy"] = cfg.remat_policy
         mem = compiled_memory_bytes(compiled)
         if mem:
             out["compiled_peak_mem_bytes"] = int(mem)
@@ -290,6 +301,16 @@ def main() -> None:
     if child == "resnet_sgd":
         print(json.dumps({"elapsed": timed_resnet(False, bs, steps)[0]}))
         return
+    if child == "tricks_resnet":
+        # bag-of-tricks OFF arm: same workload/optimizer, every speed
+        # lever disabled (fp32, autodiff conv+BN, no fusion)
+        os.environ["FDT_BENCH_TRICKS"] = "off"
+        print(json.dumps({"elapsed": timed_resnet(True, bs, steps)[0]}))
+        return
+    if child == "tricks_tf":
+        os.environ["FDT_BENCH_TRICKS"] = "off"
+        print(json.dumps(timed_transformer(256, 256, tf_steps)))
+        return
     if child.startswith(("tf_", "tfr_")):
         tag, cbs, cseq = child.split("_")
         print(json.dumps(timed_transformer(int(cbs), int(cseq), tf_steps,
@@ -329,11 +350,14 @@ def main() -> None:
         # plus XLA's own cost analysis and the compiled peak memory.
         # tfr_256_512 is the remat capacity point (VERDICT r2 #2): the
         # same config with layer checkpointing, showing the memory delta.
+        tf256_elapsed = None
         for tag, cbs, cseq in (("tf", 256, 256), ("tf", 64, 512),
                                ("tf", 256, 512), ("tfr", 256, 512)):
             res = _run_child(f"{tag}_{cbs}_{cseq}")
             if not res:
                 continue
+            if (tag, cbs, cseq) == ("tf", 256, 256):
+                tf256_elapsed = res["elapsed"]
             name = f"bs{cbs}_seq{cseq}" + ("_remat" if tag == "tfr" else "")
             exs = cbs * tf_steps / res["elapsed"] / n_chips
             if tag == "tf" and (cbs, cseq) in ((256, 256), (64, 512)):
@@ -359,7 +383,30 @@ def main() -> None:
             if "xla_bytes_accessed_per_step" in res:
                 record[f"transformer_{name}_xla_gb_per_step"] = round(
                     res["xla_bytes_accessed_per_step"] / 1e9, 2)
-        if os.environ.get("FDT_BENCH_ATTN") == "1":
+            if "remat_policy" in res:
+                record[f"transformer_{name}_policy"] = res["remat_policy"]
+        # Bag-of-tricks end-to-end ablation (VERDICT r3 #1/#2): the same
+        # train step with EVERY speed lever disabled (resolve_tricks:
+        # fp32, dense attention, naive MLP, unfused QKV, autodiff
+        # conv+BN, threefry nn.Dropout) vs the default stack — the
+        # analog of the reference's headline ~2.5x figure
+        # (/root/reference/README.md:63, figures/time.png).
+        off_r = _run_child("tricks_resnet")
+        if off_r:
+            record["tricks_speedup_resnet50"] = round(
+                off_r["elapsed"] / elapsed, 2)
+        off_t = _run_child("tricks_tf")
+        if off_t and tf256_elapsed:
+            record["tricks_speedup_transformer"] = round(
+                off_t["elapsed"] / tf256_elapsed, 2)
+            # the headline analog: the reference's time.png measures the
+            # transformer workload
+            record["tricks_speedup_x"] = record["tricks_speedup_transformer"]
+        # Long-context attention ladder: DEFAULT-ON (VERDICT r3 #4 — the
+        # driver runs plain `python bench.py`, so the envelope numbers
+        # must land in BENCH_r*.json without hand-running).  Opt out with
+        # FDT_BENCH_ATTN=0.
+        if os.environ.get("FDT_BENCH_ATTN", "1") != "0":
             ladder = _run_child("attn_ladder")
             if ladder:
                 record.update(ladder)

@@ -66,6 +66,15 @@ class TrainConfig:
                                       # analog, transformer_test.py:4,221-222)
     host_offload: bool = False        # FSDP param offload to host memory
     remat: bool = False               # jax.checkpoint the model blocks
+    remat_policy: str = "attn_out"    # transformer --remat granularity.
+                                      # attn_out (default): whole-layer
+                                      # remat but the attention context is
+                                      # SAVED so the kernel never re-runs —
+                                      # measured bs256/seq512: 941 ex/s @
+                                      # 4.9 GB vs layer 560 @ 4.1, ffn
+                                      # 1074 @ 10.7, dots 838 @ 8.0, none
+                                      # ~1080 @ 15.7.  Also: ffn | layer |
+                                      # dots
     donate: bool = True               # donate the train state into the step
                                       # (in-place update; disable on backends
                                       # with donated-buffer dealloc bugs)
@@ -84,9 +93,29 @@ class TrainConfig:
     n_heads: int = 8
     attention: str = ""               # "" auto | dense | flash | ring | ulysses
     mlp_impl: str = ""                # "" auto (pallas on TPU) | fused | pallas
-    dropout_rng_impl: str = "rbg"     # rbg (XLA hardware-RNG path; measured
-                                      # +33% transformer step throughput) |
-                                      # threefry (bit-reproducible masks)
+    dropout_impl: str = "hash"        # hash (stateless index-hash masks,
+                                      # seed-only backward residual, bit-
+                                      # reproducible AND fastest measured —
+                                      # ops/dropout.py) | xla (flax
+                                      # nn.Dropout) | none (floor probes)
+    dropout_rng_impl: str = "threefry"  # PRNG for the xla dropout impl:
+                                      # threefry (bit-reproducible masks,
+                                      # the default — ADVICE r3 #2) | rbg
+                                      # (hardware-RNG path, faster mask
+                                      # GENERATION but backend-dependent
+                                      # bits; superseded by dropout_impl=
+                                      # hash, which is faster than both)
+
+    # -- bag-of-tricks ablation (reference README.md:63: ~2.5x end-to-end
+    # from AMP + kernel fusion + prefetch + distributed) -------------------
+    tricks: str = "on"                # on | off.  "off" disables EVERY
+                                      # speed lever at once: bf16->fp32,
+                                      # flash->dense attention, Pallas/
+                                      # fused MLP->naive, fused QKV->3
+                                      # Linears, conv recompute->autodiff,
+                                      # hash dropout->threefry nn.Dropout,
+                                      # prefetch/workers->synchronous.
+                                      # resolve_tricks() applies it.
 
     # -- bookkeeping ------------------------------------------------------
     seed: int = 123456                # resnet50_test.py:728
@@ -112,6 +141,26 @@ class TrainConfig:
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
+
+
+def resolve_tricks(cfg: "TrainConfig") -> "TrainConfig":
+    """Apply the bag-of-tricks switch: tricks="off" rewrites every
+    speed-lever field to its naive setting (the ablation baseline the
+    reference's headline ~2.5x figure is measured against,
+    /root/reference/README.md:63).  Model-level levers without a config
+    field (fused QKV, conv recompute) are read off cfg.tricks by
+    cli.build_model."""
+    if cfg.tricks != "off":
+        return cfg
+    return cfg.replace(
+        precision="fp32",
+        attention="dense",
+        mlp_impl="naive",
+        dropout_impl="xla",
+        dropout_rng_impl="threefry",
+        prefetch_depth=0,
+        workers=0,
+    )
 
 
 def build_parser(prog: str = "fdt",
@@ -163,6 +212,13 @@ def build_parser(prog: str = "fdt",
                         "(ZeRO-1; params stay replicated)")
     p.add_argument("--host_offload", action="store_true")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat_policy", default=d.remat_policy,
+                   choices=["ffn", "layer", "attn_out", "dots"],
+                   help="what --remat checkpoints on the transformer: "
+                        "ffn = FFN sublayer only, layer = whole encoder "
+                        "layer (max savings), attn_out = whole layer but "
+                        "the attention context is saved so the kernel "
+                        "never re-runs, dots = XLA matmul-saveable policy")
     p.add_argument("--data_dir", default=d.data_dir, type=str)
     p.add_argument("--dataset", default=None, type=str)
     p.add_argument("--subset_stride", default=d.subset_stride, type=int,
@@ -196,11 +252,23 @@ def build_parser(prog: str = "fdt",
                    choices=["", "fused", "pallas"],
                    help="classifier MLP kernel ('' = pallas on TPU, else "
                         "the custom_vjp fused path)")
+    p.add_argument("--tricks", default=d.tricks, choices=["on", "off"],
+                   help="bag-of-tricks switch: off = disable every speed "
+                        "lever at once (fp32, dense attention, naive MLP, "
+                        "unfused QKV, autodiff conv+BN, threefry "
+                        "nn.Dropout, synchronous loading) — the ablation "
+                        "baseline for the end-to-end speedup figure")
+    p.add_argument("--dropout_impl", default=d.dropout_impl,
+                   choices=["hash", "xla", "none"],
+                   help="dropout engine: hash = stateless index-hash masks "
+                        "(no mask tensor in HBM, bit-reproducible, fastest "
+                        "measured), xla = flax nn.Dropout (PRNG per "
+                        "--dropout_rng_impl), none = disabled (probes)")
     p.add_argument("--dropout_rng_impl", default=d.dropout_rng_impl,
-                   choices=["rbg", "threefry"],
-                   help="PRNG for dropout masks: rbg = XLA hardware-RNG "
-                        "path (+33%% measured transformer throughput), "
-                        "threefry = bit-reproducible masks")
+                   choices=["threefry", "rbg"],
+                   help="PRNG for the xla dropout impl: threefry = bit-"
+                        "reproducible masks (default), rbg = hardware-RNG "
+                        "path (faster generation, backend-dependent bits)")
     return p
 
 
@@ -233,7 +301,7 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         ngd_max_dim=args.ngd_max_dim,
         device=args.device, precision=args.precision,
         fsdp=args.fsdp, zero1=args.zero1, host_offload=args.host_offload,
-        remat=args.remat,
+        remat=args.remat, remat_policy=args.remat_policy,
         data_dir=args.data_dir, subset_stride=args.subset_stride, seed=args.seed,
         checkpoint_dir=args.checkpoint_dir, profile=args.profile,
         log_every=args.log_every,
@@ -241,8 +309,10 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         auto_recover=args.auto_recover, debug=args.debug,
         seq_len=args.seq_len, n_layers=args.n_layers, d_model=args.d_model,
         d_ff=args.d_ff, n_heads=args.n_heads, attention=args.attention,
-        mlp_impl=args.mlp_impl, dropout_rng_impl=args.dropout_rng_impl,
+        mlp_impl=args.mlp_impl, dropout_impl=args.dropout_impl,
+        dropout_rng_impl=args.dropout_rng_impl, tricks=args.tricks,
     )
+    cfg = resolve_tricks(cfg)
     if args.model:
         cfg = cfg.replace(model=args.model)
     if args.dataset:

@@ -325,7 +325,12 @@ def device_prefetch(iterator: Iterable, put_fn: Callable[[Any], Any],
                     depth: int = 2) -> Iterator:
     """Keep `depth` batches already transferred to device ahead of the
     consumer — overlaps H2D with compute like pin_memory+non_blocking
-    (resnet50_test.py:522)."""
+    (resnet50_test.py:522).  depth <= 0 = fully synchronous transfer
+    per batch (the bag-of-tricks OFF arm: no double buffering)."""
+    if depth <= 0:
+        for item in iterator:
+            yield put_fn(item)
+        return
     staged = []
     it = iter(iterator)
     exhausted = False

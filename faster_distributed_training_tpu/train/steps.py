@@ -92,18 +92,20 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
         state = fetch(state)
         step_key = jax.random.fold_in(state.rng, state.step)
         k_mix, k_drop = jax.random.split(step_key)
-        if cfg.dropout_rng_impl == "rbg":
-            # Dropout masks through the rbg PRNG (XLA RngBitGenerator —
-            # the TPU's hardware-RNG path) instead of threefry, which
-            # costs ~100 vector ops per draw and was measured to eat
-            # 34% of the transformer step (163 -> 123 ms/step at
-            # bs=256/seq=256, +33% throughput).  Only the DROPOUT
-            # stream switches: mixup/init stay threefry (tiny tensors,
-            # reproducibility-sensitive), and the attention-prob
-            # dropout keeps its placement-independent index hash
-            # (ops.attention.dropout_keep).  rbg bits are
-            # backend-dependent — mask patterns are not pinned across
-            # platforms, which nothing relies on.
+        if cfg.dropout_rng_impl == "rbg" and cfg.dropout_impl == "xla":
+            # Opt-in: dropout masks through the rbg PRNG (XLA
+            # RngBitGenerator — the TPU's hardware-RNG path) instead of
+            # threefry, which costs ~100 vector ops per draw and was
+            # measured to eat 34% of the transformer step in round 3.
+            # Only meaningful with cfg.dropout_impl == "xla": the
+            # default hash dropout (ops/dropout.py) never draws mask
+            # bits from this key at all (it derives one u32 seed per
+            # site), is faster than the rbg path AND bit-reproducible,
+            # which is why threefry is back as the rng default
+            # (ADVICE r3 #2).  Only the DROPOUT stream switches:
+            # mixup/init stay threefry, and the attention-prob dropout
+            # keeps its placement-independent index hash
+            # (ops.attention.dropout_keep).
             k_drop = jax.random.wrap_key_data(
                 jax.random.bits(k_drop, (4,), jnp.uint32), impl="rbg")
         y = batch["label"]

@@ -149,6 +149,13 @@ class TestLoaders:
         seen = list(device_prefetch(iter(range(7)), lambda x: x * 2, depth=2))
         assert seen == [0, 2, 4, 6, 8, 10, 12]
 
+    def test_device_prefetch_depth_zero_is_synchronous_not_empty(self):
+        # regression (r4): depth=0 (the bag-of-tricks OFF arm) must yield
+        # every batch synchronously — the old staging loop staged nothing
+        # and yielded NOTHING, killing the epoch
+        seen = list(device_prefetch(iter(range(5)), lambda x: x + 1, depth=0))
+        assert seen == [1, 2, 3, 4, 5]
+
     def test_parallel_batch_iterator_matches_serial(self):
         # --workers N: concurrent materialization, strictly ordered output
         from faster_distributed_training_tpu.data.loader import (

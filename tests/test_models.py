@@ -142,11 +142,13 @@ class TestTransformer:
                    for p in jax.tree.leaves(variables["params"]))
         assert ours == ref_count + 2 * kw["d_model"], (ours, ref_count)
 
-    def test_remat_gradients_match_no_remat(self):
-        """--remat must be a pure memory/compute trade: forward values and
-        parameter gradients identical with and without layer checkpointing
-        (regression for the round-2 dead flag — Transformer.remat was
-        declared and CLI-passed but never wired)."""
+    @pytest.mark.parametrize("policy", ["ffn", "layer", "attn_out", "dots"])
+    def test_remat_gradients_match_no_remat(self, policy):
+        """--remat must be a pure memory/compute trade under EVERY policy
+        (VERDICT r3 #3: ffn/layer/dots): forward values and parameter
+        gradients identical with and without checkpointing (regression
+        for the round-2 dead flag — Transformer.remat was declared and
+        CLI-passed but never wired)."""
         kw = dict(n_class=4, vocab=64, n_layers=2, h=4, d_model=32,
                   d_ff=64, d_hidden=64, maxlen=16, alpha=0.0)
         x = jnp.asarray(np.random.default_rng(3).integers(
@@ -169,7 +171,8 @@ class TestTransformer:
 
         l0, g0 = jax.value_and_grad(loss_fn)(variables["params"], base)
         l1, g1 = jax.value_and_grad(loss_fn)(
-            variables["params"], Transformer(**kw, remat=True))
+            variables["params"],
+            Transformer(**kw, remat=True, remat_policy=policy))
         np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
         for p0, p1 in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
             np.testing.assert_allclose(np.asarray(p0), np.asarray(p1),
