@@ -53,8 +53,13 @@ def run_arm(name: str) -> dict:
                                                         resolve_tricks)
 
     if model == "transformer":
+        # the reference transformer_test.py workload is maxlen=512 at
+        # global bs=256 over 4 GPUs — i.e. 64 per device, which is what
+        # one chip gets here.  seq matters: dense fp32 attention in the
+        # OFF arm scales O(L^2) (at bs=256 on one 16 GB chip the OFF arm
+        # doesn't even FIT — the tricks are what make that batch runnable)
         cfg = TrainConfig(model="transformer", dataset="agnews",
-                          num_classes=4, batch_size=256, seq_len=256,
+                          num_classes=4, batch_size=64, seq_len=512,
                           lr=5e-5, optimizer="mirror_madgrad",
                           weight_decay=0.0, alpha=0.99, epochs=epochs,
                           subset_stride=int(os.environ.get(
@@ -95,16 +100,21 @@ def draw_figure(results: dict, path: str, speedups: dict) -> None:
             times = results.get(arm)
             if not times:
                 continue
-            cum = np.cumsum([0.0] + times)
-            ax.plot(range(len(cum)), cum, color=color, linewidth=2,
-                    label=label)
-            ax.annotate(f"{cum[-1]:.0f}s", (len(cum) - 1, cum[-1]),
+            # epoch 0 carries the one-time jit compile (which the fused
+            # ON stack pays MORE of) — the training-time claim is the
+            # steady state, so the curve starts at epoch 1 and the
+            # compile cost is reported in the label instead
+            steady = times[1:] if len(times) > 1 else times
+            cum = np.cumsum([0.0] + steady)
+            ax.plot(range(1, len(cum) + 1), cum, color=color, linewidth=2,
+                    label=f"{label} (compile {times[0]:.0f}s)")
+            ax.annotate(f"{cum[-1]:.0f}s", (len(cum), cum[-1]),
                         textcoords="offset points", xytext=(4, 0),
                         color=_INK, fontsize=9)
         sp = speedups.get(f"tricks_speedup_{workload}_e2e")
         title = workload + (f"  ({sp:.2f}x)" if sp else "")
         ax.set_title(title, color=_INK)
-        ax.set_xlabel("epoch", color=_MUTED)
+        ax.set_xlabel("epoch (steady state, from epoch 1)", color=_MUTED)
         ax.set_ylabel("cumulative wall-clock (s)", color=_MUTED)
         ax.grid(True, color="#e8e8ee", linewidth=0.75)
         ax.set_axisbelow(True)
@@ -125,8 +135,17 @@ def main() -> None:
         print(json.dumps(run_arm(child)))
         return
 
+    # incremental re-runs: FDT_TRICKS_ARMS=a,b reruns only those arms,
+    # merging with the persisted results of earlier runs
+    results_path = os.path.join("figures", "tricks_times.json")
     results = {}
+    if os.path.exists(results_path):
+        with open(results_path) as f:
+            results = json.load(f)
+    only = [a for a in os.environ.get("FDT_TRICKS_ARMS", "").split(",") if a]
     for name in ARMS:
+        if only and name not in only:
+            continue
         env = dict(os.environ, FDT_TRICKS_CHILD=name)
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                               env=env, capture_output=True, text=True,
@@ -151,6 +170,8 @@ def main() -> None:
             record[f"tricks_speedup_{workload}_e2e"] = round(
                 (sum(off_t) / len(off_t)) / (sum(on_t) / len(on_t)), 2)
     os.makedirs("figures", exist_ok=True)
+    with open(results_path, "w") as f:
+        json.dump(results, f, indent=1)
     draw_figure(results, "figures/tricks_time.png", record)
     record["figure"] = "figures/tricks_time.png"
     record["epoch_times"] = results

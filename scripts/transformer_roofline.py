@@ -37,6 +37,12 @@ VARIANTS = {
     "sgd_256_256_xla_mlp": (256, 256, "sgd", False, "", "fused"),
     "sgd_256_256_dense_xla_mlp": (256, 256, "sgd", False, "dense", "fused"),
     "sgd_64_512_dense": (64, 512, "sgd", False, "dense", ""),
+    # dropout-impl attribution (r4): the hash default vs the xla
+    # nn.Dropout path vs the no-dropout floor — the r3 roofline found
+    # mask generation+traffic was the dominant non-matmul term
+    "ngd_256_256_drop_hash": (256, 256, "ngd", False, "", "", "hash"),
+    "ngd_256_256_drop_xla": (256, 256, "ngd", False, "", "", "xla"),
+    "ngd_256_256_drop_none": (256, 256, "ngd", False, "", "", "none"),
 }
 
 
@@ -47,6 +53,8 @@ def run_variant(name: str) -> dict:
     if extra:
         os.environ["FDT_BENCH_TF_ATTN"] = extra[0]
         os.environ["FDT_BENCH_TF_MLP"] = extra[1]
+    if len(extra) > 2:
+        os.environ["FDT_BENCH_TF_DROPOUT"] = extra[2]
     import bench
     res = bench.timed_transformer(bs, seq, steps=20, remat=remat)
     res["variant"] = name
