@@ -41,6 +41,52 @@ def test_config_mixup_mode_flag():
         build_parser().parse_args([]))) == "static"
 
 
+def test_config_tricks_off_rewrites_every_speed_lever():
+    # the bag-of-tricks ablation switch (VERDICT r3 #2): --tricks off
+    # must flip EVERY lever at once via resolve_tricks (applied inside
+    # config_from_args)
+    cfg = config_from_args(build_parser().parse_args(["--tricks", "off"]))
+    assert cfg.tricks == "off"
+    assert cfg.precision == "fp32"
+    assert cfg.attention == "dense"
+    assert cfg.mlp_impl == "naive"
+    assert cfg.dropout_impl == "xla"
+    assert cfg.dropout_rng_impl == "threefry"
+    assert cfg.prefetch_depth == 0 and cfg.workers == 0
+    # default: every lever stays on
+    on = config_from_args(build_parser().parse_args([]))
+    assert on.tricks == "on" and on.precision == "bf16"
+    assert on.dropout_impl == "hash" and on.prefetch_depth > 0
+
+
+def test_tricks_off_builds_unfused_reference_layout():
+    # the OFF arm reproduces the reference's three separate QKV Linears
+    # (transformer.py:196-227) and the naive stored-activation MLP
+    import jax
+    import jax.numpy as jnp
+
+    from faster_distributed_training_tpu.cli import build_model
+    from faster_distributed_training_tpu.config import (TrainConfig,
+                                                        resolve_tricks)
+
+    cfg = resolve_tricks(TrainConfig(
+        model="transformer", num_classes=4, seq_len=8, n_layers=1,
+        d_model=16, d_ff=32, n_heads=2, tricks="off"))
+    model = build_model(cfg, vocab_size=32)
+    assert model.fused_qkv is False and model.mlp_impl == "naive"
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1),
+         "mixup": jax.random.PRNGKey(2)},
+        jnp.zeros((2, 8), jnp.int32), train=False)
+    attn = variables["params"]["layer_0"]["attn"]
+    assert {"query", "key", "value", "out"} <= set(attn)
+    assert "qkv" not in attn
+    # resnet OFF arm: autodiff conv+BN, fp32
+    rcfg = resolve_tricks(TrainConfig(model="resnet18", tricks="off"))
+    rmodel = build_model(rcfg)
+    assert rmodel.conv_remat is False and rmodel.dtype == jnp.float32
+
+
 def test_config_mesh_and_fsdp():
     args = build_parser().parse_args(["--mesh", "dp=2,tp=4"])
     cfg = config_from_args(args)
