@@ -202,17 +202,20 @@ class TestSteps:
         assert float(me["total"]) == 4.0
 
     def test_dropout_rng_impl_rbg_and_threefry_both_train(self):
-        """The dropout stream defaults to the rbg PRNG (XLA hardware-RNG
-        path — measured +33% transformer step throughput on v5e); both
-        impls must produce finite training steps, and the masks must
-        actually differ between them (the rbg key is genuinely used)."""
-        def run(impl):
+        """With the xla dropout impl, --dropout_rng_impl selects the mask
+        PRNG (rbg hardware path vs bit-reproducible threefry): both must
+        produce finite training steps, and the masks must actually differ
+        (the rbg key is genuinely used).  Under the DEFAULT hash impl the
+        knob is intentionally inert (masks come from the index hash and
+        stay bit-reproducible — the r4 review fix), checked at the end."""
+        def run(impl, dropout_impl="xla"):
             cfg = TrainConfig(model="transformer", batch_size=4, lr=1e-3,
                               optimizer="adamw", epochs=1, num_classes=4,
+                              dropout_impl=dropout_impl,
                               dropout_rng_impl=impl)
             model = Transformer(n_class=4, vocab=50, n_layers=1, h=2,
                                 d_model=16, d_ff=32, d_hidden=32, maxlen=12,
-                                alpha=0.0)
+                                alpha=0.0, dropout_impl=dropout_impl)
             tx, _ = build_optimizer(cfg, steps_per_epoch=2)
             sample = jnp.zeros((4, 10), jnp.int32)
             state = create_train_state(model, tx, sample,
@@ -231,6 +234,8 @@ class TestSteps:
         l_tf = run("threefry")
         # same data+init, different mask streams -> different losses
         assert l_rbg != l_tf
+        # hash impl: rng knob inert, masks identical either way
+        assert run("rbg", "hash") == run("threefry", "hash")
 
     def test_fp16_step_runs_with_loss_scaling(self):
         cfg, state, batch = _resnet_setup(mixup_mode="none", precision="fp16")
