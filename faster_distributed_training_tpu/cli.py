@@ -45,17 +45,35 @@ def _host_isa_fingerprint() -> str:
     return hashlib.sha1(feat.encode()).hexdigest()[:8]
 
 
+def _configured_platform() -> str:
+    """The platform jax WILL use, read without initializing the backend
+    (jax.default_backend() would pin the platform before setup_platform's
+    --device override runs)."""
+    import jax
+
+    p = (getattr(jax.config, "jax_platforms", None)
+         or os.environ.get("JAX_PLATFORMS", ""))
+    return p.split(",")[0] if p else ""
+
+
 def enable_compilation_cache(path: str = "") -> None:
     """Persistent XLA compilation cache — TPU train-step compiles take
     minutes; cached reloads take seconds (shared across processes, e.g.
-    bench.py's subprocess comparison runs).  The directory is keyed by
-    the host's CPU feature hash so AOT CPU executables never replay on
-    an ISA-incompatible machine."""
+    bench.py's subprocess comparison runs).
+
+    On the CPU backend the directory is additionally keyed by the host's
+    CPU feature hash: CPU AOT executables compiled on a machine with
+    wider vector extensions SIGILL when replayed elsewhere (the
+    cross-machine warnings in MULTICHIP_r03's gate logs).  TPU programs
+    have no host-ISA hazard, so they share one directory across hosts —
+    keeping the driver's bench runs warm."""
     import jax
 
-    path = path or os.environ.get(
-        "FDT_COMPILATION_CACHE",
-        os.path.expanduser(f"~/.cache/fdt_xla-{_host_isa_fingerprint()}"))
+    if not path and not os.environ.get("FDT_COMPILATION_CACHE"):
+        suffix = (f"-{_host_isa_fingerprint()}"
+                  if _configured_platform().startswith("cpu") else "")
+        path = os.path.expanduser(f"~/.cache/fdt_xla{suffix}")
+    path = path or os.environ.get("FDT_COMPILATION_CACHE", "")
     try:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -72,8 +90,6 @@ def setup_platform(cfg: TrainConfig) -> None:
 
     import jax
 
-    enable_compilation_cache()
-
     if cfg.device != "auto":
         want = "tpu" if cfg.device == "tpu" else "cpu"
         try:
@@ -86,6 +102,10 @@ def setup_platform(cfg: TrainConfig) -> None:
                 jax.config.update("jax_num_cpu_devices", need)
             except Exception:
                 pass  # backend already initialized; make_mesh will report
+
+    # AFTER the platform override: the cache directory choice reads the
+    # configured platform (CPU caches are ISA-keyed, TPU caches shared)
+    enable_compilation_cache()
 
 
 def load_dataset(cfg: TrainConfig, train: bool):
