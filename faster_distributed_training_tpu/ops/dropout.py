@@ -25,6 +25,13 @@ This module removes all three costs:
     path, so bit-reproducible training comes back for free (the
     round-3 trade-off ADVICE r3 #2 flagged).
 
+Statistical note: because the stream is ``fmix(seed ^ i)``, two sites
+with seeds s1, s2 see masks related by the index permutation
+``i -> i ^ s1 ^ s2`` — a random xor-shift of one another, not fresh
+independent draws.  For dropout this is immaterial (any FIXED pair of
+elements collides with probability 2^-32 over the seed pair), and each
+site draws a fresh seed from the threefry rng tree per step.
+
 Keep-probability granularity is 1/65536 (the hash's top 16 bits against
 a u16 threshold): rate=0.1 realizes as drop probability 6554/65536 ≈
 0.100006.  The survivor scale uses the REALIZED keep probability, so
@@ -35,8 +42,6 @@ itself is statistically irrelevant and tested.
 from __future__ import annotations
 
 import functools
-import math
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
