@@ -204,7 +204,10 @@ class AGNewsDataset:
                             "train.csv" if train else "test.csv")
         self.buckets = tuple(buckets)
         self.samples: List[Tuple[str, int]] = []
-        if os.path.exists(path):
+        # isfile, not exists: a failed download can leave a stray empty
+        # DIRECTORY at the CSV path (observed round 5 — IsADirectoryError
+        # instead of the clean FileNotFoundError fallback)
+        if os.path.isfile(path):
             with open(path, newline="", encoding="utf-8") as f:
                 for i, row in enumerate(csv.reader(f)):
                     if subset_stride > 1 and i % subset_stride:

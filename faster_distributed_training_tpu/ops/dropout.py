@@ -34,9 +34,12 @@ site draws a fresh seed from the threefry rng tree per step.
 
 Keep-probability granularity is 1/65536 (the hash's top 16 bits against
 a u16 threshold): rate=0.1 realizes as drop probability 6554/65536 ≈
-0.100006.  The survivor scale uses the REALIZED keep probability, so
-E[dropout(x)] == x holds exactly; the ≤1/65536 quantization of the rate
-itself is statistically irrelevant and tested.
+0.100006.  The survivor scale uses the REALIZED keep probability and is
+applied in float32 with ONE final cast to the activation dtype (ADVICE
+r4 #3 — scaling in bf16 would round 1/keep to 8 mantissa bits, a
+systematic ~0.4% scale bias), so E[dropout(x)] == x holds exactly in
+fp32 and to one final-rounding ulp in bf16; the ≤1/65536 quantization of
+the rate itself is statistically irrelevant and tested.
 """
 
 from __future__ import annotations
@@ -71,19 +74,26 @@ def hash_words(seed: jax.Array, n: int) -> jax.Array:
     return _fmix32(seed.astype(jnp.uint32) ^ lax.iota(jnp.uint32, n))
 
 
-def _keep_factor(seed: jax.Array, shape, rate: float, dtype) -> jax.Array:
-    """0 or 1/realized_keep per element, shaped like the input."""
+def _keep_factor(seed: jax.Array, shape, rate: float) -> jax.Array:
+    """0 or 1/realized_keep per element, shaped like the input — ALWAYS
+    float32: the scale multiplies in fp32 and the product is cast back
+    to the activation dtype once (ADVICE r4 #3; casting the factor
+    itself to bf16 first would bias the scale by up to ~0.4%)."""
     t = _thresh_u16(rate)
     n = int(np.prod(shape)) if shape else 1
     h16 = (hash_words(seed, n) >> jnp.uint32(16)).reshape(shape)
     inv = np.float32(_GRID / t)  # exact-unbiasedness scale (realized keep)
-    return jnp.where(h16 < jnp.uint32(t), jnp.asarray(inv, dtype),
-                     jnp.asarray(0.0, dtype))
+    return jnp.where(h16 < jnp.uint32(t), inv, np.float32(0.0))
+
+
+def _scale(x: jax.Array, factor: jax.Array) -> jax.Array:
+    """x * factor computed in fp32, one rounding back to x.dtype."""
+    return (x.astype(jnp.float32) * factor).astype(x.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _hash_dropout(x: jax.Array, seed: jax.Array, rate: float) -> jax.Array:
-    return x * _keep_factor(seed, x.shape, rate, x.dtype)
+    return _scale(x, _keep_factor(seed, x.shape, rate))
 
 
 def _hd_fwd(x, seed, rate):
@@ -93,7 +103,7 @@ def _hd_fwd(x, seed, rate):
 
 def _hd_bwd(rate, seed, g):
     # the cotangent has the primal's shape/dtype; the mask is REGENERATED
-    dx = g * _keep_factor(seed, g.shape, rate, g.dtype)
+    dx = _scale(g, _keep_factor(seed, g.shape, rate))
     return dx, np.zeros((), jax.dtypes.float0)
 
 

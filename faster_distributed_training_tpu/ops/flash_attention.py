@@ -30,12 +30,14 @@ TPU-first replacement for the reference's dense ScaledDotProduct
     FDT_FORCE_PALLAS_INTERPRET=1 to exercise both kernels in
     interpreter mode on CPU.
 
-Head-dim support set (VERDICT r3 #6): the K-blocked kernels require
+Head-dim support set (VERDICT r3 #7): the K-blocked kernels require
 ``D <= 128 or D % 128 == 0`` (`_kblocked_supported` — the running-stat
 lane broadcast needs a whole number of 128-lane repeats).  A model
 whose head dim violates that (e.g. D=192) AND whose Lk·D exceeds the
 monolithic envelope routes to the XLA blockwise formulation — slower
-but functionally identical; `test_flash.py` pins that routing.  Odd
+but functionally identical; pinned by `tests/test_attention.py::
+TestKernelEnvelopeRouting::test_unsupported_head_dim_routes_to_blockwise`.
+Odd
 head dims inside the monolithic envelope run the monolithic kernels
 as usual (Mosaic pads lanes).
 

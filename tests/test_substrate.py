@@ -24,6 +24,70 @@ def test_config_reference_flags():
     assert cfg.epochs == 30 and cfg.alpha == 0.4
 
 
+def test_cache_dir_isa_keyed_unless_tpu(monkeypatch):
+    """ADVICE r4 #1 / VERDICT r4 #5: the persistent-cache directory must
+    be ISA-keyed on EVERY path that isn't a known TPU platform —
+    including the default where no platform is configured at all (the
+    --device auto / early-bench hazard) — and version-bumped so stale
+    round-4 entries can't load."""
+    from faster_distributed_training_tpu import cli
+
+    fp = cli._host_isa_fingerprint()
+    for plat, keyed in (("", True), ("cpu", True), ("cuda", True),
+                        ("tpu", False), ("axon", False)):
+        monkeypatch.setattr(cli, "_configured_platform", lambda p=plat: p)
+        d = cli._default_cache_dir()
+        assert "fdt_xla_v2" in d, d
+        assert d.endswith(f"-{fp}") == keyed, (plat, d)
+
+
+def test_bench_regression_guard():
+    """VERDICT r4 #2c: bench flags >5% wrong-way moves per metric
+    direction (throughput/speedup/MFU up=good; ms/overhead/mem up=bad)."""
+    import importlib.util
+    import os as _os
+    spec = importlib.util.spec_from_file_location(
+        "bench", _os.path.join(_os.path.dirname(__file__), "..", "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    prev = {"value": 100.0, "ngd_overhead_pct": 5.0,
+            "attn_fwdbwd_ms_L2048": 8.0, "attn_fwdbwd_ms_L4096": 14.0,
+            "tricks_speedup_x": 2.7,
+            "transformer_bs256_seq256_mfu_pct": 25.0,
+            "resnet_ngd_step_ms": 130.0}
+    rec = {"value": 90.0,                 # -10% throughput: regression
+           "ngd_overhead_pct": 7.0,       # +2 pp: past the 1.5pp tolerance
+           "attn_fwdbwd_ms_L2048": 9.0,   # +12.5%: tunnel noise, NOT flagged
+           "attn_fwdbwd_ms_L4096": 20.0,  # +43%: past the 25% ladder band
+           "tricks_speedup_x": 2.9,       # up = good
+           "transformer_bs256_seq256_mfu_pct": 26.0,  # up = good
+           "resnet_ngd_step_ms": 125.0,   # down = good
+           "baseline_note": "strings are skipped"}
+    regs = bench._find_regressions(rec, prev)
+    assert {r["metric"] for r in regs} == {
+        "value", "ngd_overhead_pct", "attn_fwdbwd_ms_L4096"}
+    by = {r["metric"]: r for r in regs}
+    assert by["value"]["change_pct"] == -10.0
+    assert by["ngd_overhead_pct"]["change_pct"] == 2.0  # pp, not relative
+    assert by["attn_fwdbwd_ms_L4096"]["prev"] == 14.0
+    # a pp metric IMPROVING is never flagged
+    assert not bench._find_regressions({"ngd_overhead_pct": 3.0},
+                                       {"ngd_overhead_pct": 5.0})
+    # a tracked metric VANISHING (child subprocess death) is flagged
+    gone = bench._find_regressions({"value": 100.0},
+                                   {"value": 100.0,
+                                    "attn_fwdbwd_ms_L2048": 8.0,
+                                    "untracked_thing": 3.0})
+    assert gone == [{"metric": "attn_fwdbwd_ms_L2048", "prev": 8.0,
+                     "now": None, "missing": True}]
+    # and the repo's real previous record parses, unwrapping the
+    # driver's {n, cmd, rc, tail, parsed} envelope to the record itself
+    prev_rec, prev_file = bench._prev_bench_record()
+    assert prev_rec and prev_file.startswith("BENCH_r")
+    assert "value" in prev_rec and "attn_fwdbwd_ms_L8192" in prev_rec
+
+
 def test_config_mixup_mode_flag():
     # every mixup variant is reachable from the CLI (VERDICT r1 weak #2)
     from faster_distributed_training_tpu.train.steps import resolve_mixup_mode
