@@ -195,13 +195,10 @@ def _restore_legacy(path: str, template: Any, structural: Exception) -> Any:
     migrated = dict(params)
     migrated["model"] = migrate_legacy_transformer_params(
         params["model"], n_heads)
-    t_flat = jax.tree_util.tree_flatten_with_path(template["params"])[0]
-    m_leaves = {jax.tree_util.keystr(p): v for p, v in
-                jax.tree_util.tree_flatten_with_path(migrated)[0]}
-    for p, tv in t_flat:
-        key = jax.tree_util.keystr(p)
-        if key not in m_leaves or np.shape(m_leaves[key]) != np.shape(tv):
-            raise structural
+    try:
+        rebuilt = _fit_leaves(migrated, template["params"], "params")
+    except ValueError:
+        raise structural
     warnings.warn(
         "restored a pre-round-3 checkpoint: transformer Q/K/V kernels "
         "were folded into the fused qkv layout (forward-exact), but the "
@@ -209,9 +206,6 @@ def _restore_legacy(path: str, template: Any, structural: Exception) -> Any:
         "tracked the unfused kernels and cannot be folded — it restarts "
         "fresh, as do the RNG root and loss scale.  Expect a short "
         "re-warmup of optimizer statistics.", stacklevel=3)
-    rebuilt = jax.tree_util.tree_unflatten(
-        jax.tree_util.tree_structure(template["params"]),
-        [np.asarray(m_leaves[jax.tree_util.keystr(p)]) for p, _ in t_flat])
     return {"step": raw.get("step", template["step"]),
             "params": rebuilt,
             "batch_stats": _fit_or_template(
@@ -222,32 +216,39 @@ def _restore_legacy(path: str, template: Any, structural: Exception) -> Any:
             "rng": template["rng"]}
 
 
+def _fit_leaves(raw_sub: Any, template_sub: Any, label: str) -> Any:
+    """Fit a raw-restored subtree onto the template's structure: every
+    template leaf must exist (matched by key path) with an identical
+    shape; returns the rebuilt tree or raises ValueError.  Shared core
+    of the params (raise) and batch_stats (warn-and-fallback) paths."""
+    t_flat = jax.tree_util.tree_flatten_with_path(template_sub)[0]
+    r_leaves = {jax.tree_util.keystr(p): v for p, v in
+                jax.tree_util.tree_flatten_with_path(raw_sub)[0]}
+    if len(r_leaves) != len(t_flat):
+        raise ValueError(f"{label}: leaf count "
+                         f"{len(r_leaves)} != {len(t_flat)}")
+    leaves = []
+    for p, tv in t_flat:
+        key = jax.tree_util.keystr(p)
+        if key not in r_leaves:
+            raise ValueError(f"{label}: missing leaf {key}")
+        if np.shape(r_leaves[key]) != np.shape(tv):
+            raise ValueError(
+                f"{label}: {key} shape {np.shape(r_leaves[key])} != "
+                f"template {np.shape(tv)}")
+        leaves.append(np.asarray(r_leaves[key]))
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template_sub), leaves)
+
+
 def _fit_or_template(raw_sub: Any, template_sub: Any, label: str) -> Any:
-    """Fit a raw-restored subtree onto the template's structure with the
-    same leaf-shape validation params get (ADVICE r4 #2); on ANY
-    mismatch fall back to the template subtree with a warning instead of
-    returning wrong-shaped leaves that fail later."""
+    """_fit_leaves with warn-and-fallback (ADVICE r4 #2): on ANY
+    mismatch return the template subtree with a warning instead of
+    wrong-shaped leaves that fail later."""
     if raw_sub is None:
         return template_sub
     try:
-        t_flat = jax.tree_util.tree_flatten_with_path(template_sub)[0]
-        r_leaves = {jax.tree_util.keystr(p): v for p, v in
-                    jax.tree_util.tree_flatten_with_path(raw_sub)[0]}
-        if len(r_leaves) != len(t_flat):
-            raise ValueError(f"{label}: leaf count "
-                             f"{len(r_leaves)} != {len(t_flat)}")
-        leaves = []
-        for p, tv in t_flat:
-            key = jax.tree_util.keystr(p)
-            if key not in r_leaves:
-                raise ValueError(f"{label}: missing leaf {key}")
-            if np.shape(r_leaves[key]) != np.shape(tv):
-                raise ValueError(
-                    f"{label}: {key} shape {np.shape(r_leaves[key])} != "
-                    f"template {np.shape(tv)}")
-            leaves.append(np.asarray(r_leaves[key]))
-        return jax.tree_util.tree_unflatten(
-            jax.tree_util.tree_structure(template_sub), leaves)
+        return _fit_leaves(raw_sub, template_sub, label)
     except Exception as e:
         warnings.warn(
             f"legacy checkpoint's {label} does not fit the restore "

@@ -43,6 +43,13 @@ VARIANTS = {
     "ngd_256_256_drop_hash": (256, 256, "ngd", False, "", "", "hash"),
     "ngd_256_256_drop_xla": (256, 256, "ngd", False, "", "", "xla"),
     "ngd_256_256_drop_none": (256, 256, "ngd", False, "", "", "none"),
+    # LayerNorm attribution (r5): TorchLayerNorm as identity (params
+    # still registered so state shapes match) — the delta vs the
+    # baseline is the 13 LN sites' end-to-end cost.  Measured on a
+    # quiet chip: 112.3 -> 104.8 ms/step @ bs256/seq256, i.e. LN is
+    # ~7.5 ms = ~6.7% of the step (pure HBM round-trips: 13 sites x
+    # read+write in fwd and bwd ~ 4-5 GB/step at ~800 GB/s).
+    "ngd_256_256_noln": (256, 256, "ngd", False, "", "", "hash", "noln"),
 }
 
 
@@ -55,6 +62,15 @@ def run_variant(name: str) -> dict:
         os.environ["FDT_BENCH_TF_MLP"] = extra[1]
     if len(extra) > 2:
         os.environ["FDT_BENCH_TF_DROPOUT"] = extra[2]
+    if len(extra) > 3 and extra[3] == "noln":
+        from faster_distributed_training_tpu.models import transformer as T
+        _orig_ln = T.TorchLayerNorm.__call__
+
+        def _ident_ln(self, x):
+            _orig_ln(self, x)   # register scale/bias params, drop result
+            return x
+
+        T.TorchLayerNorm.__call__ = _ident_ln
     import bench
     res = bench.timed_transformer(bs, seq, steps=20, remat=remat)
     res["variant"] = name
