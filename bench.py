@@ -196,6 +196,7 @@ def timed_transformer(bs: int, seq: int, steps: int,
                                     "") or "attn_out",
         attention=os.environ.get("FDT_BENCH_TF_ATTN", ""),
         mlp_impl=os.environ.get("FDT_BENCH_TF_MLP", ""),
+        ffn_impl=os.environ.get("FDT_BENCH_TF_FFN", "") or "flax",
         dropout_impl=os.environ.get("FDT_BENCH_TF_DROPOUT", "") or "hash",
         tricks=os.environ.get("FDT_BENCH_TRICKS", "") or "on"))
     model = build_model(cfg, vocab_size=30522, mesh=mesh)

@@ -280,6 +280,28 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                 "--mlp_impl pallas off-TPU runs the kernel in Pallas "
                 "INTERPRET mode (orders of magnitude slower) — test-only; "
                 "use --mlp_impl fused for real off-TPU runs", stacklevel=2)
+        ffn_impl = cfg.ffn_impl
+        if ffn_impl == "pallas":
+            # pallas_call does not SPMD-partition: under ANY sharded mesh
+            # axis (tp weights, sp sequence, dp/fsdp batch) the jitted
+            # step would replicate or fail to lower — the kernel is a
+            # SINGLE-CHIP capacity lever for now (PARITY)
+            if mesh is not None and any(
+                    mesh.shape[ax] > 1 for ax in mesh.axis_names):
+                import warnings
+                warnings.warn(
+                    "--ffn_impl pallas is single-chip only (pallas_call "
+                    "does not SPMD-partition sharded operands); falling "
+                    "back to the flax FFN composition on this "
+                    f"{dict(mesh.shape)} mesh", stacklevel=2)
+                ffn_impl = "flax"
+            elif jax.default_backend() != "tpu":
+                import warnings
+                warnings.warn(
+                    "--ffn_impl pallas off-TPU runs the kernel in Pallas "
+                    "INTERPRET mode (orders of magnitude slower) — "
+                    "test-only; use the default flax FFN for real "
+                    "off-TPU runs", stacklevel=2)
         return get_model("transformer", cfg.num_classes,
                          vocab=vocab_size or 30522, maxlen=cfg.seq_len,
                          n_layers=cfg.n_layers, d_model=cfg.d_model,
@@ -289,7 +311,7 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                          alpha=cfg.alpha if cfg.alpha > 0 else 0.99,
                          dtype=dtype, remat=cfg.remat,
                          remat_policy=cfg.remat_policy,
-                         dropout_impl=cfg.dropout_impl,
+                         dropout_impl=cfg.dropout_impl, ffn_impl=ffn_impl,
                          fused_qkv=not tricks_off)
     return get_model(cfg.model, cfg.num_classes, dtype=dtype,
                      remat=cfg.remat, conv_remat=not tricks_off)

@@ -93,6 +93,12 @@ class TrainConfig:
     n_heads: int = 8
     attention: str = ""               # "" auto | dense | flash | ring | ulysses
     mlp_impl: str = ""                # "" auto (pallas on TPU) | fused | pallas
+    ffn_impl: str = "flax"            # flax | pallas: fused LN+FFN+dropout+
+                                      # residual sublayer kernel
+                                      # (ops/fused_ffn.py) — a capacity
+                                      # lever (zero FFN-shaped backward
+                                      # residuals); see PARITY for the
+                                      # measured time trade
     dropout_impl: str = "hash"        # hash (stateless index-hash masks,
                                       # seed-only backward residual, bit-
                                       # reproducible AND fastest measured —
@@ -252,6 +258,12 @@ def build_parser(prog: str = "fdt",
                    choices=["", "fused", "pallas"],
                    help="classifier MLP kernel ('' = pallas on TPU, else "
                         "the custom_vjp fused path)")
+    p.add_argument("--ffn_impl", default=d.ffn_impl,
+                   choices=["flax", "pallas"],
+                   help="FFN sublayer impl: flax = Dense/GELU composition "
+                        "(default), pallas = fused LN+FFN+dropout+residual "
+                        "kernel with recompute backward (capacity lever; "
+                        "not valid with a tp-sharded mesh)")
     p.add_argument("--tricks", default=d.tricks, choices=["on", "off"],
                    help="bag-of-tricks switch: off = disable every speed "
                         "lever at once (fp32, dense attention, naive MLP, "
@@ -309,7 +321,8 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         auto_recover=args.auto_recover, debug=args.debug,
         seq_len=args.seq_len, n_layers=args.n_layers, d_model=args.d_model,
         d_ff=args.d_ff, n_heads=args.n_heads, attention=args.attention,
-        mlp_impl=args.mlp_impl, dropout_impl=args.dropout_impl,
+        mlp_impl=args.mlp_impl, ffn_impl=args.ffn_impl,
+        dropout_impl=args.dropout_impl,
         dropout_rng_impl=args.dropout_rng_impl, tricks=args.tricks,
     )
     cfg = resolve_tricks(cfg)

@@ -268,6 +268,31 @@ class PositionalWiseFFN(nn.Module):
 REMAT_POLICIES = ("layer", "ffn", "attn_out", "dots")
 
 
+class _FFNParamMirror(nn.Module):
+    """Declares PositionalWiseFFN's exact param tree (Dense_0 -> d_ff,
+    Dense_1 -> d_model, same auto-naming order) WITHOUT its compute —
+    the fused-FFN kernel path (`ffn_impl="pallas"`) reads the leaves and
+    runs the math in `ops.fused_ffn`, keeping checkpoints interchangeable
+    between the Flax and kernel implementations.  The probe call is
+    (1, d_model) — parameter creation only, negligible compute."""
+    d_model: int
+    d_ff: int
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, probe: jax.Array):
+        kw = dict(kernel_init=xavier_uniform, dtype=self.dtype,
+                  param_dtype=self.param_dtype)
+        d0 = nn.Dense(self.d_ff, **kw)
+        d1 = nn.Dense(self.d_model, **kw)
+        d1(d0(probe))
+        return (d0.variables["params"]["kernel"],
+                d0.variables["params"]["bias"],
+                d1.variables["params"]["kernel"],
+                d1.variables["params"]["bias"])
+
+
 class EncoderLayer(nn.Module):
     """One pre-LN attention sublayer + one pre-LN FFN sublayer
     (transformer.py:245-275).  Factored into its own module so
@@ -289,6 +314,7 @@ class EncoderLayer(nn.Module):
     dropout_impl: str = "hash"
     remat_ffn: bool = False   # checkpoint the FFN sublayer only ("ffn")
     fused_qkv: bool = True
+    ffn_impl: str = "flax"    # flax | pallas (ops/fused_ffn.py mega-kernel)
 
     @nn.compact
     def __call__(self, h: jax.Array, mask: Optional[jax.Array],
@@ -304,6 +330,37 @@ class EncoderLayer(nn.Module):
         a = FastDropout(self.dropout_connection_attention,
                         self.dropout_impl)(a, deterministic=not train)
         h = h + a
+        if self.ffn_impl == "pallas":
+            # fused sublayer (ops/fused_ffn.py): LN + FFN + both dropout
+            # sites + residual in one Pallas kernel, recompute backward —
+            # zero FFN-shaped residuals (a capacity lever; see PARITY for
+            # the measured time trade).  Param trees mirror the Flax path
+            # exactly.  NOT compatible with tp-sharded FFN weights
+            # (pallas_call does not SPMD-partition) — build_model keeps
+            # the Flax path whenever a tp axis is live.
+            from faster_distributed_training_tpu.ops.fused_ffn import (
+                fused_ffn_sublayer)
+            lnf = ln("ln_ffn")
+            lnf(h[..., :1, :])      # param creation only (probe row)
+            ln_scale = lnf.variables["params"]["scale"]
+            ln_bias = lnf.variables["params"]["bias"]
+            w1, b1, w2, b2 = _FFNParamMirror(
+                self.d_model, self.d_ff, self.dtype, self.param_dtype,
+                name="ffn")(h[..., :1, :])
+            training = train and (self.dropout_ffn > 0
+                                  or self.dropout_connection_ffn > 0)
+            if training:
+                seeds = jax.random.bits(self.make_rng("dropout"), (2,),
+                                        dtype=jnp.uint32)
+                hid_seed, out_seed = seeds[0], seeds[1]
+                r_h, r_c = self.dropout_ffn, self.dropout_connection_ffn
+            else:
+                hid_seed = out_seed = jnp.uint32(0)
+                r_h = r_c = 0.0
+            return fused_ffn_sublayer(
+                h, ln_scale, ln_bias, w1.astype(self.dtype),
+                b1.astype(self.dtype), w2.astype(self.dtype),
+                b2.astype(self.dtype), hid_seed, out_seed, r_h, r_c)
         f = ln("ln_ffn")(h)
         ffn_cls = (nn.remat(PositionalWiseFFN, static_argnums=(2,))
                    if self.remat_ffn else PositionalWiseFFN)
@@ -342,6 +399,7 @@ class Transformer(nn.Module):
     remat_policy: str = "attn_out"  # layer | ffn | attn_out | dots
                                    # (see REMAT_POLICIES)
     dropout_impl: str = "hash"     # hash | xla | none (ops/dropout.py)
+    ffn_impl: str = "flax"         # flax | pallas (fused FFN sublayer)
     fused_qkv: bool = True         # False = reference's 3 separate QKV
                                    # Linears (bag-of-tricks ablation arm)
 
@@ -401,6 +459,7 @@ class Transformer(nn.Module):
                           self.dtype, self.param_dtype,
                           self.attention_impl, self.mesh, self.sp_axis,
                           self.dropout_impl, remat_ffn, self.fused_qkv,
+                          self.ffn_impl,
                           name=f"layer_{i}")(h, mask, train)
 
         ln = lambda name: TorchLayerNorm(   # noqa: E731

@@ -9,6 +9,8 @@ kernels for the attention hot path.
 
 from faster_distributed_training_tpu.ops.conv_bn import (  # noqa: F401
     conv2d, conv_bn_train, fused_conv_bn, conv_bn_reference)
+from faster_distributed_training_tpu.ops.fused_ffn import (  # noqa: F401
+    ffn_sublayer_reference, fused_ffn_sublayer)
 from faster_distributed_training_tpu.ops.fused_mlp import (  # noqa: F401
     fused_mlp, fused_mlp_pallas, mlp_reference)
 from faster_distributed_training_tpu.ops.attention import (  # noqa: F401

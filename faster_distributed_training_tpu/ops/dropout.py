@@ -74,16 +74,32 @@ def hash_words(seed: jax.Array, n: int) -> jax.Array:
     return _fmix32(seed.astype(jnp.uint32) ^ lax.iota(jnp.uint32, n))
 
 
+def keep_factor_tile(seed: jax.Array, row0: jax.Array, rows: int, cols: int,
+                     rate: float) -> jax.Array:
+    """fp32 {0, GRID/t} keep factors for a (rows, cols) tile whose global
+    flat indices start at ``row0 * cols`` — THE single source of truth
+    for the hash-dropout mask stream.  ``row0=0`` over the full tensor
+    reproduces ``hash_dropout``'s mask exactly; Pallas kernels
+    (ops/fused_ffn.py) call it per row-block with the block's global row
+    offset, so in-kernel masks and the module-level engine agree by
+    construction."""
+    t = _thresh_u16(rate)
+    r = lax.broadcasted_iota(jnp.uint32, (rows, cols), 0)
+    c = lax.broadcasted_iota(jnp.uint32, (rows, cols), 1)
+    idx = (row0.astype(jnp.uint32) + r) * jnp.uint32(cols) + c
+    h16 = _fmix32(seed.astype(jnp.uint32) ^ idx) >> jnp.uint32(16)
+    inv = np.float32(_GRID / t)  # exact-unbiasedness scale (realized keep)
+    return jnp.where(h16 < jnp.uint32(t), inv, np.float32(0.0))
+
+
 def _keep_factor(seed: jax.Array, shape, rate: float) -> jax.Array:
     """0 or 1/realized_keep per element, shaped like the input — ALWAYS
     float32: the scale multiplies in fp32 and the product is cast back
     to the activation dtype once (ADVICE r4 #3; casting the factor
-    itself to bf16 first would bias the scale by up to ~0.4%)."""
-    t = _thresh_u16(rate)
+    itself to bf16 first would bias the scale by up to ~0.4%).  Built on
+    keep_factor_tile so every consumer shares one stream definition."""
     n = int(np.prod(shape)) if shape else 1
-    h16 = (hash_words(seed, n) >> jnp.uint32(16)).reshape(shape)
-    inv = np.float32(_GRID / t)  # exact-unbiasedness scale (realized keep)
-    return jnp.where(h16 < jnp.uint32(t), inv, np.float32(0.0))
+    return keep_factor_tile(seed, jnp.uint32(0), 1, n, rate).reshape(shape)
 
 
 def _scale(x: jax.Array, factor: jax.Array) -> jax.Array:

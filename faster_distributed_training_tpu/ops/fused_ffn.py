@@ -1,0 +1,229 @@
+"""Fused pre-LN FFN sublayer: one Pallas kernel for
+LN -> Dense(d_ff) -> GELU -> dropout -> Dense(d_model) -> dropout -> +residual.
+
+Motivation (VERDICT r4 #1 "attack the gap"): the round-5 identity-LN
+probe measured the transformer's 13 LayerNorm sites at ~7.5 ms of the
+112 ms step @ bs256/seq256 (`scripts/transformer_roofline.py
+ngd_256_256_noln`) — pure HBM round-trips, which XLA cannot fuse into
+the adjacent GEMMs (reductions only fuse with elementwise consumers,
+never into a dot).  This kernel computes the WHOLE pre-LN FFN sublayer
+of `models/transformer.py::EncoderLayer` per row-block with every
+intermediate (LN output, d_ff hidden, GELU, dropout masks, residual sum)
+living only in VMEM: HBM traffic drops from ~5 tensor round-trips to
+read-h + write-out.
+
+Design:
+  * forward — Pallas kernel, grid over row blocks; weights VMEM-resident
+    ((512,1024)+(1024,512) bf16 = 2 MiB of the ~16 MiB budget).  LN runs
+    in fp32 with the reference's exact semantics (TorchLayerNorm,
+    transformer.py:230-242: UNBIASED variance, eps added to the std);
+    GEMMs accumulate fp32 on the MXU; GELU is the exact erf form
+    (torch nn.GELU default); both dropout sites are the stateless
+    index-hash masks of `ops/dropout.py` (murmur3 finalizer over
+    seed ^ global-flat-index, keep iff top-16 bits < t, survivor scale
+    GRID/t applied in fp32) so the backward can regenerate them
+    bit-exactly from the two u32 seeds.
+  * backward — ``jax.custom_vjp`` whose residuals are the INPUTS only
+    (h, LN params, weights, seeds); the bwd pass is ``jax.vjp`` of the
+    pure-XLA reference forward below, so gradients are correct by
+    construction and the big dW GEMMs run as single XLA dots (measured
+    at ~82% MFU on this chip — a hand-tiled Pallas accumulation would
+    be slower).  This also makes the sublayer remat-free: nothing
+    FFN-shaped is ever saved for backward.
+  * off-TPU the kernel runs in Pallas interpret mode (tests); the model
+    integration gates the kernel behind ``ffn_impl="pallas"`` and keeps
+    the Flax composition as the default/ablation arm.
+
+Numerics note: the kernel's GELU/dropout/second-GEMM chain runs in fp32
+until the final cast while the Flax composition casts to bf16 between
+every op, so kernel-vs-Flax outputs differ by normal bf16 rounding
+(~1e-2 relative on bf16 activations); kernel-vs-REFERENCE-fn (same op
+order) agrees to fp32/bf16 tolerance and is what the tests pin.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from faster_distributed_training_tpu.ops.dropout import keep_factor_tile
+
+try:
+    from jax.experimental import pallas as pl
+except ImportError:  # pragma: no cover
+    pl = None
+
+
+def _erf_f32(x: jax.Array) -> jax.Array:
+    """erf via the Abramowitz-Stegun 7.1.26 polynomial (|err| measured
+    4.2e-7 in fp32, far below bf16's ~8e-3 resolution) — Mosaic has no
+    erf primitive, so the
+    kernel AND the reference/backward fn share this implementation (they
+    must agree bit-for-bit for the vjp-of-reference backward to see the
+    forward's exact activations)."""
+    a1, a2, a3 = np.float32(0.254829592), np.float32(-0.284496736), \
+        np.float32(1.421413741)
+    a4, a5, p = np.float32(-1.453152027), np.float32(1.061405429), \
+        np.float32(0.3275911)
+    s = jnp.sign(x)
+    ax = jnp.abs(x)
+    t = 1.0 / (1.0 + p * ax)
+    y = 1.0 - (((((a5 * t + a4) * t) + a3) * t + a2) * t + a1) * t \
+        * jnp.exp(-ax * ax)
+    return s * y
+
+
+def _gelu_f32(h1: jax.Array) -> jax.Array:
+    """Exact-form GELU (torch nn.GELU default) on fp32 pre-activations."""
+    return 0.5 * h1 * (1.0 + _erf_f32(h1 * np.float32(1.0 / np.sqrt(2.0))))
+
+
+def _ln_f32(x32: jax.Array, scale: jax.Array, bias: jax.Array,
+            eps: float) -> jax.Array:
+    """TorchLayerNorm in fp32: unbiased var, eps added to std."""
+    d = x32.shape[-1]
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.sum(jnp.square(x32 - mean), axis=-1, keepdims=True) / (d - 1)
+    return scale * ((x32 - mean) / (jnp.sqrt(var) + eps)) + bias
+
+
+# the mask stream lives in ops/dropout.py (one source of truth); this
+# module consumes it per row-block with the block's global row offset
+_keep_f32 = keep_factor_tile
+
+
+def ffn_sublayer_reference(h: jax.Array, ln_scale: jax.Array,
+                           ln_bias: jax.Array, w1: jax.Array, b1: jax.Array,
+                           w2: jax.Array, b2: jax.Array,
+                           hid_seed: jax.Array, out_seed: jax.Array,
+                           rate_hidden: float, rate_conn: float,
+                           eps: float = 1e-6) -> jax.Array:
+    """Pure-XLA oracle with the kernel's exact op order and dtypes.
+    Weights in Flax Dense layout (in, out).  Also the bwd math source:
+    the custom_vjp backward is jax.vjp of THIS function."""
+    lead = h.shape[:-1]
+    d = h.shape[-1]
+    x32 = h.reshape(-1, d).astype(jnp.float32)
+    f = _ln_f32(x32, ln_scale.astype(jnp.float32),
+                ln_bias.astype(jnp.float32), eps).astype(h.dtype)
+    h1 = jnp.dot(f, w1, preferred_element_type=jnp.float32) \
+        + b1.astype(jnp.float32)
+    a = _gelu_f32(h1)
+    if rate_hidden > 0.0:
+        n_rows = a.shape[0]
+        a = a * _keep_f32(hid_seed, jnp.uint32(0), n_rows, a.shape[1],
+                          rate_hidden)
+    a = a.astype(h.dtype)
+    f2 = jnp.dot(a, w2, preferred_element_type=jnp.float32) \
+        + b2.astype(jnp.float32)
+    if rate_conn > 0.0:
+        f2 = f2 * _keep_f32(out_seed, jnp.uint32(0), f2.shape[0],
+                            f2.shape[1], rate_conn)
+    out = x32 + f2
+    return out.astype(h.dtype).reshape(*lead, d)
+
+
+def _ffn_kernel(h_ref, lns_ref, lnb_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+                seeds_ref, o_ref, *, block_rows: int,
+                rate_hidden: float, rate_conn: float, eps: float):
+    row0 = pl.program_id(0) * block_rows
+    x32 = h_ref[...].astype(jnp.float32)
+    f = _ln_f32(x32, lns_ref[...].astype(jnp.float32),
+                lnb_ref[...].astype(jnp.float32), eps).astype(h_ref.dtype)
+    h1 = jax.lax.dot(f, w1_ref[...],
+                     preferred_element_type=jnp.float32) \
+        + b1_ref[...].astype(jnp.float32)
+    a = _gelu_f32(h1)
+    if rate_hidden > 0.0:
+        a = a * _keep_f32(seeds_ref[0, 0], jnp.uint32(row0), a.shape[0],
+                          a.shape[1], rate_hidden)
+    a = a.astype(h_ref.dtype)
+    f2 = jax.lax.dot(a, w2_ref[...],
+                     preferred_element_type=jnp.float32) \
+        + b2_ref[...].astype(jnp.float32)
+    if rate_conn > 0.0:
+        f2 = f2 * _keep_f32(seeds_ref[0, 1], jnp.uint32(row0), f2.shape[0],
+                            f2.shape[1], rate_conn)
+    o_ref[...] = (x32 + f2).astype(o_ref.dtype)
+
+
+def _ffn_fwd_pallas(h2d, ln_scale, ln_bias, w1, b1, w2, b2, seeds,
+                    rate_hidden, rate_conn, eps, block_rows=256):
+    B, d = h2d.shape
+    d_ff = w1.shape[1]
+    block_rows = min(block_rows, B)
+    nb = -(-B // block_rows)
+    pad = nb * block_rows - B
+    if pad:
+        # NOTE: padded rows still hash dropout indices past B*d — fine,
+        # they are sliced away and real rows' indices are unaffected.
+        h2d = jnp.pad(h2d, ((0, pad), (0, 0)))
+    kern = functools.partial(_ffn_kernel, block_rows=block_rows,
+                             rate_hidden=rate_hidden, rate_conn=rate_conn,
+                             eps=eps)
+    out = pl.pallas_call(
+        kern,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((d, d_ff), lambda i: (0, 0)),
+            pl.BlockSpec((1, d_ff), lambda i: (0, 0)),
+            pl.BlockSpec((d_ff, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, 2), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb * block_rows, d), h2d.dtype),
+        interpret=(jax.default_backend() != "tpu"),
+    )(h2d, ln_scale.reshape(1, d), ln_bias.reshape(1, d), w1,
+      b1.reshape(1, d_ff), w2, b2.reshape(1, d), seeds)
+    return out[:B] if pad else out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+def fused_ffn_sublayer(h, ln_scale, ln_bias, w1, b1, w2, b2,
+                       hid_seed, out_seed,
+                       rate_hidden: float = 0.0, rate_conn: float = 0.0,
+                       eps: float = 1e-6):
+    """out = h + drop(Dense2(drop(gelu(Dense1(LN(h)))))) in ONE Pallas
+    kernel (see module docstring).  h: (..., d_model); weights in Flax
+    (in, out) layout; seeds: u32 scalars (ignored when the static rates
+    are 0 — pass anything).  Gradients flow to h, LN params, weights and
+    biases; seeds are non-differentiable."""
+    lead = h.shape[:-1]
+    d = h.shape[-1]
+    seeds = jnp.stack([jnp.asarray(hid_seed, jnp.uint32),
+                       jnp.asarray(out_seed, jnp.uint32)]).reshape(1, 2)
+    out = _ffn_fwd_pallas(h.reshape(-1, d), ln_scale, ln_bias, w1, b1,
+                          w2, b2, seeds, rate_hidden, rate_conn, eps)
+    return out.reshape(*lead, d)
+
+
+def _ffn_vjp_fwd(h, ln_scale, ln_bias, w1, b1, w2, b2, hid_seed, out_seed,
+                 rate_hidden, rate_conn, eps):
+    out = fused_ffn_sublayer(h, ln_scale, ln_bias, w1, b1, w2, b2,
+                             hid_seed, out_seed, rate_hidden, rate_conn, eps)
+    # residuals: INPUTS only — nothing FFN-shaped is saved (the whole
+    # sublayer is recomputed by the reference fn inside the bwd vjp)
+    return out, (h, ln_scale, ln_bias, w1, b1, w2, b2, hid_seed, out_seed)
+
+
+def _ffn_vjp_bwd(rate_hidden, rate_conn, eps, res, g):
+    h, ln_scale, ln_bias, w1, b1, w2, b2, hid_seed, out_seed = res
+    _, vjp = jax.vjp(
+        lambda h_, s_, bi_, w1_, b1_, w2_, b2_: ffn_sublayer_reference(
+            h_, s_, bi_, w1_, b1_, w2_, b2_, hid_seed, out_seed,
+            rate_hidden, rate_conn, eps),
+        h, ln_scale, ln_bias, w1, b1, w2, b2)
+    zero = np.zeros((), jax.dtypes.float0)
+    return (*vjp(g), zero, zero)
+
+
+fused_ffn_sublayer.defvjp(_ffn_vjp_fwd, _ffn_vjp_bwd)

@@ -200,3 +200,135 @@ class TestConvBNTrain:
         g = jax.grad(lambda x_: jnp.sum(
             fused_conv_bn(x_, w, 1, 0, 1e-3)[0] ** 2))(x)
         assert np.isfinite(np.asarray(g)).all()
+
+
+class TestFusedFFNSublayer:
+    """ops/fused_ffn.py — the whole pre-LN FFN sublayer (LN -> Dense ->
+    GELU -> dropout -> Dense -> dropout -> +residual) as ONE Pallas
+    kernel with a vjp-of-reference recompute backward.  Measured role
+    (PARITY): an intermediate capacity rung (-11% peak memory for +8%
+    step time at bs256/seq512), NOT a throughput win — XLA's
+    saved-intermediate autodiff beats recompute on time."""
+
+    def _inputs(self, dtype=jnp.float32, B=4, L=8, d=32, dff=64):
+        rr = np.random.default_rng(0)
+        h = jnp.asarray(rr.normal(size=(B, L, d)), dtype)
+        lns = jnp.asarray(rr.normal(size=(d,)) * 0.1 + 1.0, jnp.float32)
+        lnb = jnp.asarray(rr.normal(size=(d,)) * 0.1, jnp.float32)
+        w1 = jnp.asarray(rr.normal(size=(d, dff)) * 0.1, dtype)
+        b1 = jnp.asarray(rr.normal(size=(dff,)) * 0.1, dtype)
+        w2 = jnp.asarray(rr.normal(size=(dff, d)) * 0.1, dtype)
+        b2 = jnp.asarray(rr.normal(size=(d,)) * 0.1, dtype)
+        return h, lns, lnb, w1, b1, w2, b2
+
+    @pytest.mark.parametrize("rates", [(0.0, 0.0), (0.1, 0.1)])
+    def test_kernel_matches_reference_fwd_and_grads(self, rates):
+        from faster_distributed_training_tpu.ops.fused_ffn import (
+            ffn_sublayer_reference, fused_ffn_sublayer)
+
+        args = self._inputs()
+        s1, s2 = jnp.uint32(11), jnp.uint32(22)
+        rh, rc = rates
+        out = fused_ffn_sublayer(*args, s1, s2, rh, rc)
+        ref = ffn_sublayer_reference(*args, s1, s2, rh, rc)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+        gk = jax.grad(lambda *a: jnp.sum(
+            fused_ffn_sublayer(*a, s1, s2, rh, rc) ** 2),
+            argnums=tuple(range(7)))(*args)
+        gr = jax.grad(lambda *a: jnp.sum(
+            ffn_sublayer_reference(*a, s1, s2, rh, rc) ** 2),
+            argnums=tuple(range(7)))(*args)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_no_ffn_shaped_backward_residuals(self):
+        """The custom_vjp must save INPUTS only: no residual leaf may
+        carry the (rows, d_ff) hidden shape — that is the whole point
+        of the fusion (capacity)."""
+        from faster_distributed_training_tpu.ops.fused_ffn import (
+            fused_ffn_sublayer)
+
+        h, lns, lnb, w1, b1, w2, b2 = self._inputs(B=16)
+        n_hidden = h.shape[0] * h.shape[1] * w1.shape[1]
+        _, vjp = jax.vjp(
+            lambda h_: fused_ffn_sublayer(h_, lns, lnb, w1, b1, w2, b2,
+                                          jnp.uint32(1), jnp.uint32(2),
+                                          0.1, 0.1), h)
+        for leaf in jax.tree.leaves(vjp):
+            assert np.size(leaf) < n_hidden, np.shape(leaf)
+
+    def test_dropout_stream_matches_hash_dropout(self):
+        """The in-kernel masks must equal ops.dropout.hash_dropout on the
+        full tensor (same (seed, flat-index) stream), so backward
+        regeneration and the module-level engine agree."""
+        from faster_distributed_training_tpu.ops.dropout import hash_dropout
+        from faster_distributed_training_tpu.ops.fused_ffn import _keep_f32
+
+        seed = jnp.uint32(77)
+        rows, cols = 16, 32
+        ones = jnp.ones((rows, cols), jnp.float32)
+        via_kernel = np.asarray(
+            ones * _keep_f32(seed, jnp.uint32(0), rows, cols, 0.3))
+        via_module = np.asarray(hash_dropout(ones, seed, 0.3))
+        np.testing.assert_array_equal(via_kernel, via_module)
+
+    def test_erf_polynomial_accuracy(self):
+        """Mosaic has no erf; the A&S 7.1.26 polynomial must stay within
+        ~5e-7 of lax.erf in fp32 (measured 4.2e-7 — far below bf16's
+        ~8e-3 resolution)."""
+        from faster_distributed_training_tpu.ops.fused_ffn import _erf_f32
+
+        x = jnp.linspace(-6.0, 6.0, 4001, dtype=jnp.float32)
+        err = np.abs(np.asarray(_erf_f32(x))
+                     - np.asarray(jax.lax.erf(x)))
+        assert float(err.max()) < 1e-6
+
+    def test_model_param_tree_identical_and_eval_equal(self):
+        """ffn_impl='pallas' must keep the EXACT param tree of the flax
+        path (checkpoints interchange) and agree at eval."""
+        from faster_distributed_training_tpu.models import Transformer
+
+        x = jnp.asarray(np.random.default_rng(0).integers(0, 64, size=(4, 8)),
+                        jnp.int32)
+        rng = jax.random.PRNGKey(0)
+        models, trees = {}, {}
+        for impl in ("flax", "pallas"):
+            m = Transformer(n_class=4, vocab=64, n_layers=2, h=2, d_model=16,
+                            d_ff=32, d_hidden=16, maxlen=8, ffn_impl=impl)
+            v = m.init({"params": rng, "dropout": rng, "mixup": rng},
+                       x, train=True)
+            models[impl] = m
+            trees[impl] = (jax.tree_util.tree_structure(v["params"]), v)
+        assert trees["flax"][0] == trees["pallas"][0]
+        params = trees["flax"][1]["params"]
+        ef = models["flax"].apply({"params": params}, x, train=False)
+        ep = models["pallas"].apply({"params": params}, x, train=False)
+        np.testing.assert_allclose(np.asarray(ef), np.asarray(ep),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_model_trains_through_kernel(self):
+        from faster_distributed_training_tpu.models import Transformer
+
+        m = Transformer(n_class=4, vocab=64, n_layers=2, h=2, d_model=16,
+                        d_ff=32, d_hidden=16, maxlen=8, ffn_impl="pallas")
+        x = jnp.asarray(np.random.default_rng(1).integers(0, 64, size=(4, 8)),
+                        jnp.int32)
+        rng = jax.random.PRNGKey(0)
+        v = m.init({"params": rng, "dropout": rng, "mixup": rng},
+                   x, train=True)
+
+        def loss(p):
+            lg, idx, lam = m.apply({"params": p}, x, train=True,
+                                   rngs={"dropout": jax.random.PRNGKey(1),
+                                         "mixup": jax.random.PRNGKey(2)})
+            return jnp.mean(lg ** 2)
+
+        l, g = jax.value_and_grad(loss)(v["params"])
+        assert np.isfinite(float(l))
+        assert all(np.all(np.isfinite(np.asarray(t)))
+                   for t in jax.tree.leaves(g))
+        # FFN weights actually receive gradient through the kernel path
+        gffn = g["layer_0"]["ffn"]["Dense_0"]["kernel"]
+        assert float(jnp.max(jnp.abs(gffn))) > 0.0
