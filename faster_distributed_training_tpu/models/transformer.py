@@ -69,14 +69,17 @@ class TorchLayerNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        from faster_distributed_training_tpu.ops.layernorm import (
+            torch_layernorm_f32)
+
         d = x.shape[-1]
         a = self.param("scale", nn.initializers.ones, (d,), self.param_dtype)
         b = self.param("bias", nn.initializers.zeros, (d,), self.param_dtype)
-        x32 = x.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        # fp32 core shared with the fused FFN kernel (ops/layernorm.py):
         # unbiased std (torch x.std default), eps added to std not var
-        var = jnp.sum(jnp.square(x32 - mean), axis=-1, keepdims=True) / (d - 1)
-        y = a * ((x32 - mean) / (jnp.sqrt(var) + self.eps)) + b
+        y = torch_layernorm_f32(x.astype(jnp.float32),
+                                a.astype(jnp.float32),
+                                b.astype(jnp.float32), self.eps)
         return y.astype(self.dtype)
 
 

@@ -84,6 +84,8 @@ def keep_factor_tile(seed: jax.Array, row0: jax.Array, rows: int, cols: int,
     offset, so in-kernel masks and the module-level engine agree by
     construction."""
     t = _thresh_u16(rate)
+    if t <= 0:   # rate within half a grid step of 1: drop everything
+        return jnp.zeros((rows, cols), jnp.float32)
     r = lax.broadcasted_iota(jnp.uint32, (rows, cols), 0)
     c = lax.broadcasted_iota(jnp.uint32, (rows, cols), 1)
     idx = (row0.astype(jnp.uint32) + r) * jnp.uint32(cols) + c

@@ -52,6 +52,7 @@ import numpy as np
 from jax import lax
 
 from faster_distributed_training_tpu.ops.dropout import keep_factor_tile
+from faster_distributed_training_tpu.ops.layernorm import torch_layernorm_f32
 
 try:
     from jax.experimental import pallas as pl
@@ -83,13 +84,9 @@ def _gelu_f32(h1: jax.Array) -> jax.Array:
     return 0.5 * h1 * (1.0 + _erf_f32(h1 * np.float32(1.0 / np.sqrt(2.0))))
 
 
-def _ln_f32(x32: jax.Array, scale: jax.Array, bias: jax.Array,
-            eps: float) -> jax.Array:
-    """TorchLayerNorm in fp32: unbiased var, eps added to std."""
-    d = x32.shape[-1]
-    mean = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.sum(jnp.square(x32 - mean), axis=-1, keepdims=True) / (d - 1)
-    return scale * ((x32 - mean) / (jnp.sqrt(var) + eps)) + bias
+# TorchLayerNorm's fp32 core — ONE definition shared with the Flax
+# module (ops/layernorm.py), so kernel and model can't desynchronize
+_ln_f32 = torch_layernorm_f32
 
 
 # the mask stream lives in ops/dropout.py (one source of truth); this

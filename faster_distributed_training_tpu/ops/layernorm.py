@@ -1,0 +1,26 @@
+"""The reference's hand-rolled LayerNorm math, ONE definition.
+
+The reference normalizes with UNBIASED std and adds eps to the std, not
+the variance (transformer.py:230-242) — nonstandard on both counts, so
+the fp32 core lives here and every consumer delegates:
+``models.transformer.TorchLayerNorm`` (the Flax module) and
+``ops.fused_ffn`` (the fused FFN-sublayer kernel and its reference/
+backward fn).  A semantics change in one place cannot silently
+desynchronize the implementations (the checkpoint-interchange guarantee
+between ``ffn_impl`` settings depends on them agreeing).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def torch_layernorm_f32(x32: jax.Array, scale: jax.Array, bias: jax.Array,
+                        eps: float) -> jax.Array:
+    """fp32 TorchLayerNorm over the last axis: unbiased variance (n-1),
+    eps added to the STD.  Inputs and outputs fp32; callers cast."""
+    d = x32.shape[-1]
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.sum(jnp.square(x32 - mean), axis=-1, keepdims=True) / (d - 1)
+    return scale * ((x32 - mean) / (jnp.sqrt(var) + eps)) + bias

@@ -50,6 +50,11 @@ VARIANTS = {
     # ~7.5 ms = ~6.7% of the step (pure HBM round-trips: 13 sites x
     # read+write in fwd and bwd ~ 4-5 GB/step at ~800 GB/s).
     "ngd_256_256_noln": (256, 256, "ngd", False, "", "", "hash", "noln"),
+    # Fused FFN-sublayer kernel (r5, ops/fused_ffn.py): the capacity-
+    # lever arm beside the flax default — measured 244 ms @ 10.7 GB vs
+    # flax 225 @ 12.0 at bs256/seq512 (PARITY).
+    "ngd_256_512_ffn_pallas": (256, 512, "ngd", False, "", "", "hash",
+                               "ffn_pallas"),
 }
 
 
@@ -62,7 +67,8 @@ def run_variant(name: str) -> dict:
         os.environ["FDT_BENCH_TF_MLP"] = extra[1]
     if len(extra) > 2:
         os.environ["FDT_BENCH_TF_DROPOUT"] = extra[2]
-    if len(extra) > 3 and extra[3] == "noln":
+    mode = extra[3] if len(extra) > 3 else ""
+    if mode == "noln":
         from faster_distributed_training_tpu.models import transformer as T
         _orig_ln = T.TorchLayerNorm.__call__
 
@@ -71,6 +77,8 @@ def run_variant(name: str) -> dict:
             return x
 
         T.TorchLayerNorm.__call__ = _ident_ln
+    elif mode == "ffn_pallas":
+        os.environ["FDT_BENCH_TF_FFN"] = "pallas"
     import bench
     res = bench.timed_transformer(bs, seq, steps=20, remat=remat)
     res["variant"] = name

@@ -262,7 +262,8 @@ class TestFusedFFNSublayer:
     def test_dropout_stream_matches_hash_dropout(self):
         """The in-kernel masks must equal ops.dropout.hash_dropout on the
         full tensor (same (seed, flat-index) stream), so backward
-        regeneration and the module-level engine agree."""
+        regeneration and the module-level engine agree — including at a
+        NONZERO row offset (the per-block path real shapes exercise)."""
         from faster_distributed_training_tpu.ops.dropout import hash_dropout
         from faster_distributed_training_tpu.ops.fused_ffn import _keep_f32
 
@@ -273,6 +274,36 @@ class TestFusedFFNSublayer:
             ones * _keep_f32(seed, jnp.uint32(0), rows, cols, 0.3))
         via_module = np.asarray(hash_dropout(ones, seed, 0.3))
         np.testing.assert_array_equal(via_kernel, via_module)
+        # row0=6: the tile must reproduce rows 6.. of the full stream
+        tail = np.asarray(jnp.ones((rows - 6, cols), jnp.float32)
+                          * _keep_f32(seed, jnp.uint32(6), rows - 6, cols,
+                                      0.3))
+        np.testing.assert_array_equal(tail, via_module[6:])
+        # rate ~1 drops everything instead of dividing by zero
+        assert float(np.abs(_keep_f32(seed, jnp.uint32(0), 4, 8,
+                                      1.0 - 1e-9)).max()) == 0.0
+
+    def test_multi_block_grid_and_padding(self):
+        """Rows > block_rows exercise the grid>1 path (per-block row0
+        dropout offsets) and a non-multiple row count exercises the
+        pad-and-slice path — both must still match the reference."""
+        from faster_distributed_training_tpu.ops.fused_ffn import (
+            ffn_sublayer_reference, fused_ffn_sublayer)
+
+        # 300 rows with block_rows=256 -> 2 blocks, 212 rows of padding
+        args = self._inputs(B=30, L=10)
+        s1, s2 = jnp.uint32(5), jnp.uint32(6)
+        out = fused_ffn_sublayer(*args, s1, s2, 0.3, 0.2)
+        ref = ffn_sublayer_reference(*args, s1, s2, 0.3, 0.2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+        gk = jax.grad(lambda h: jnp.sum(
+            fused_ffn_sublayer(h, *args[1:], s1, s2, 0.3, 0.2) ** 2))(args[0])
+        gr = jax.grad(lambda h: jnp.sum(
+            ffn_sublayer_reference(h, *args[1:], s1, s2, 0.3, 0.2) ** 2))(
+            args[0])
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-5)
 
     def test_erf_polynomial_accuracy(self):
         """Mosaic has no erf; the A&S 7.1.26 polynomial must stay within

@@ -151,6 +151,32 @@ def test_tricks_off_builds_unfused_reference_layout():
     assert rmodel.conv_remat is False and rmodel.dtype == jnp.float32
 
 
+def test_ffn_impl_pallas_falls_back_on_sharded_mesh(devices8):
+    """--ffn_impl pallas is single-chip only: build_model must fall back
+    to the flax composition (loudly) on ANY sharded mesh axis — tp, sp,
+    or dp alike (pallas_call does not SPMD-partition) — and keep the
+    kernel on an all-size-1 mesh."""
+    import warnings as _w
+
+    from faster_distributed_training_tpu.cli import build_model
+    from faster_distributed_training_tpu.config import TrainConfig
+    from faster_distributed_training_tpu.parallel import make_mesh
+
+    cfg = TrainConfig(model="transformer", num_classes=4, seq_len=8,
+                      n_layers=1, d_model=16, d_ff=32, n_heads=2,
+                      ffn_impl="pallas")
+    for axes, shape, expect in ((("dp",), (8,), "flax"),
+                                (("dp", "sp"), (1, 8), "flax"),
+                                (("dp",), (1,), "pallas")):
+        mesh = make_mesh(axes, shape, devices8[:int(np.prod(shape))])
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            model = build_model(cfg, vocab_size=32, mesh=mesh)
+        assert model.ffn_impl == expect, (axes, shape)
+        if expect == "flax":
+            assert any("single-chip" in str(r.message) for r in rec)
+
+
 def test_config_mesh_and_fsdp():
     args = build_parser().parse_args(["--mesh", "dp=2,tp=4"])
     cfg = config_from_args(args)
