@@ -282,18 +282,19 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                 "use --mlp_impl fused for real off-TPU runs", stacklevel=2)
         ffn_impl = cfg.ffn_impl
         if ffn_impl == "pallas":
-            # pallas_call does not SPMD-partition: under ANY sharded mesh
-            # axis (tp weights, sp sequence, dp/fsdp batch) the jitted
-            # step would replicate or fail to lower — the kernel is a
-            # SINGLE-CHIP capacity lever for now (PARITY)
-            if mesh is not None and any(
-                    mesh.shape[ax] > 1 for ax in mesh.axis_names):
+            # sharded meshes run the kernel per-shard via shard_map over
+            # the data axes (fused_ffn_sublayer_sharded) — EXCEPT tp,
+            # whose FFN weights are tensor-parallel: gathering them per
+            # step inside the shard_map boundary would defeat tp, so
+            # that combination falls back to the flax composition.
+            if (mesh is not None and "tp" in mesh.axis_names
+                    and mesh.shape["tp"] > 1):
                 import warnings
                 warnings.warn(
-                    "--ffn_impl pallas is single-chip only (pallas_call "
-                    "does not SPMD-partition sharded operands); falling "
-                    "back to the flax FFN composition on this "
-                    f"{dict(mesh.shape)} mesh", stacklevel=2)
+                    "--ffn_impl pallas does not support tensor-parallel "
+                    "FFN weights (the per-shard kernel would gather them "
+                    "each step); falling back to the flax FFN composition "
+                    f"on this {dict(mesh.shape)} mesh", stacklevel=2)
                 ffn_impl = "flax"
             elif jax.default_backend() != "tpu":
                 import warnings
@@ -307,7 +308,8 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                          n_layers=cfg.n_layers, d_model=cfg.d_model,
                          d_ff=cfg.d_ff, h=cfg.n_heads,
                          attention_impl=impl, mlp_impl=mlp_impl,
-                         mesh=mesh if impl in ("ring", "ulysses") else None,
+                         mesh=mesh if (impl in ("ring", "ulysses")
+                                       or ffn_impl == "pallas") else None,
                          alpha=cfg.alpha if cfg.alpha > 0 else 0.99,
                          dtype=dtype, remat=cfg.remat,
                          remat_policy=cfg.remat_policy,

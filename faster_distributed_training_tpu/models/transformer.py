@@ -338,11 +338,13 @@ class EncoderLayer(nn.Module):
             # sites + residual in one Pallas kernel, recompute backward —
             # zero FFN-shaped residuals (a capacity lever; see PARITY for
             # the measured time trade).  Param trees mirror the Flax path
-            # exactly.  NOT compatible with tp-sharded FFN weights
-            # (pallas_call does not SPMD-partition) — build_model keeps
-            # the Flax path whenever a tp axis is live.
+            # exactly.  On sharded meshes the kernel runs PER SHARD via
+            # fused_ffn_sublayer_sharded (shard_map over the data axes,
+            # distinct per-shard mask streams); only tp SIZE > 1 falls
+            # back to Flax in build_model (gathering tensor-parallel FFN
+            # weights per step would defeat tp).
             from faster_distributed_training_tpu.ops.fused_ffn import (
-                fused_ffn_sublayer)
+                fused_ffn_sublayer, fused_ffn_sublayer_sharded)
             lnf = ln("ln_ffn")
             lnf(h[..., :1, :])      # param creation only (probe row)
             ln_scale = lnf.variables["params"]["scale"]
@@ -360,10 +362,17 @@ class EncoderLayer(nn.Module):
             else:
                 hid_seed = out_seed = jnp.uint32(0)
                 r_h = r_c = 0.0
-            return fused_ffn_sublayer(
-                h, ln_scale, ln_bias, w1.astype(self.dtype),
-                b1.astype(self.dtype), w2.astype(self.dtype),
-                b2.astype(self.dtype), hid_seed, out_seed, r_h, r_c)
+            kernel_args = (h, ln_scale, ln_bias, w1.astype(self.dtype),
+                           b1.astype(self.dtype), w2.astype(self.dtype),
+                           b2.astype(self.dtype), hid_seed, out_seed)
+            if self.mesh is not None and any(
+                    self.mesh.shape[ax] > 1 for ax in self.mesh.axis_names):
+                # SPMD: per-shard kernels over the data axes, distinct
+                # per-shard mask streams (ops/fused_ffn.py)
+                return fused_ffn_sublayer_sharded(
+                    *kernel_args, mesh=self.mesh,
+                    rate_hidden=r_h, rate_conn=r_c)
+            return fused_ffn_sublayer(*kernel_args, r_h, r_c)
         f = ln("ln_ffn")(h)
         ffn_cls = (nn.remat(PositionalWiseFFN, static_argnums=(2,))
                    if self.remat_ffn else PositionalWiseFFN)

@@ -224,3 +224,58 @@ def _ffn_vjp_bwd(rate_hidden, rate_conn, eps, res, g):
 
 
 fused_ffn_sublayer.defvjp(_ffn_vjp_fwd, _ffn_vjp_bwd)
+
+
+def fused_ffn_sublayer_sharded(h, ln_scale, ln_bias, w1, b1, w2, b2,
+                               hid_seed, out_seed, mesh,
+                               rate_hidden: float = 0.0,
+                               rate_conn: float = 0.0,
+                               eps: float = 1e-6):
+    """SPMD wrapper: the kernel runs PER SHARD under ``jax.shard_map``
+    over the mesh's data axes (batch over dp/fsdp, sequence over sp),
+    weights replicated (an fsdp/ZeRO-3-sharded weight is all-gathered by
+    the partitioner at the shard_map boundary — the same gather the Flax
+    path's dot would trigger).  Each shard folds its linearized data-axis
+    index into the dropout seeds (murmur3-mixed, inside the shard_map so
+    the custom_vjp backward sees the identical per-shard seeds), so
+    shards draw DISTINCT mask streams instead of repeating one local
+    pattern per device.  tp-sharded FFN weights remain unsupported
+    (build_model falls back — gathering tensor-parallel weights per step
+    would defeat tp)."""
+    from jax.sharding import PartitionSpec as P
+
+    from faster_distributed_training_tpu.ops.attention import _fmix32
+
+    batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names
+                       and mesh.shape[a] > 1)
+    seq_axis = "sp" if ("sp" in mesh.axis_names
+                        and mesh.shape["sp"] > 1) else None
+    if not batch_axes and seq_axis is None:
+        return fused_ffn_sublayer(h, ln_scale, ln_bias, w1, b1, w2, b2,
+                                  hid_seed, out_seed, rate_hidden,
+                                  rate_conn, eps)
+    data_spec = P(batch_axes if len(batch_axes) != 1 else batch_axes[0],
+                  seq_axis, None)
+    rep = P(None)
+
+    def per_shard(h_, lns_, lnb_, w1_, b1_, w2_, b2_, s1_, s2_):
+        ix = jnp.uint32(0)
+        for ax in batch_axes + ((seq_axis,) if seq_axis else ()):
+            ix = ix * jnp.uint32(mesh.shape[ax]) \
+                + jax.lax.axis_index(ax).astype(jnp.uint32)
+        # distinct per-shard streams; shard 0 keeps the unsharded stream
+        # (_fmix32(0) == 0), so 1-device meshes match the plain kernel
+        mix = _fmix32(ix)
+        return fused_ffn_sublayer(h_, lns_, lnb_, w1_, b1_, w2_, b2_,
+                                  s1_ ^ mix, s2_ ^ mix,
+                                  rate_hidden, rate_conn, eps)
+
+    return jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(data_spec, rep, rep, rep, rep, rep, rep, P(), P()),
+        out_specs=data_spec,
+        # the pallas_call's out_shape carries no varying-mesh-axes info,
+        # so VMA checking cannot see through it
+        check_vma=False,
+    )(h, ln_scale, ln_bias, w1, b1, w2, b2,
+      jnp.asarray(hid_seed, jnp.uint32), jnp.asarray(out_seed, jnp.uint32))

@@ -23,7 +23,9 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 VARIANTS = {
-    # name: (bs, seq, opt, remat[, attention, mlp_impl])
+    # name: (bs, seq, opt, remat[, attention, mlp_impl, dropout_impl,
+    #        mode]) — mode: "" | "noln" (identity LayerNorm probe)
+    #        | "ffn_pallas" (fused FFN-sublayer kernel arm)
     "ngd_256_256": (256, 256, "ngd", False),
     "sgd_256_256": (256, 256, "sgd", False),
     "adamw_256_256": (256, 256, "adamw", False),

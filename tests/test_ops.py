@@ -316,6 +316,46 @@ class TestFusedFFNSublayer:
                      - np.asarray(jax.lax.erf(x)))
         assert float(err.max()) < 1e-6
 
+    def test_sharded_wrapper_matches_unsharded(self, devices8):
+        """fused_ffn_sublayer_sharded on an 8-way dp mesh: without
+        dropout the per-shard kernels must reproduce the unsharded
+        output and gradients exactly (pure math, batch-split); with
+        dropout, shard 0's rows keep the unsharded stream (the seed mix
+        folds in _fmix32(shard_index) and _fmix32(0) == 0) while other
+        shards draw DISTINCT streams."""
+        from faster_distributed_training_tpu.ops.fused_ffn import (
+            fused_ffn_sublayer, fused_ffn_sublayer_sharded)
+        from faster_distributed_training_tpu.parallel import make_mesh
+
+        mesh = make_mesh(("dp",), (8,), devices8)
+        args = self._inputs(B=16)
+        s1, s2 = jnp.uint32(3), jnp.uint32(4)
+
+        plain = fused_ffn_sublayer(*args, s1, s2, 0.0, 0.0)
+        with mesh:
+            sh = fused_ffn_sublayer_sharded(*args, s1, s2, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(sh), np.asarray(plain),
+                                   rtol=1e-5, atol=1e-6)
+
+        gp = jax.grad(lambda h: jnp.sum(
+            fused_ffn_sublayer(h, *args[1:], s1, s2, 0.0, 0.0) ** 2))(args[0])
+        with mesh:
+            gs = jax.grad(lambda h: jnp.sum(
+                fused_ffn_sublayer_sharded(h, *args[1:], s1, s2,
+                                           mesh=mesh) ** 2))(args[0])
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(gp),
+                                   rtol=1e-4, atol=1e-5)
+
+        # dropout on: per-shard streams — shard 0 (batch rows 0-1)
+        # matches the plain kernel on ITS rows; some later shard differs
+        plain_d = np.asarray(fused_ffn_sublayer(*args, s1, s2, 0.4, 0.0))
+        with mesh:
+            sh_d = np.asarray(fused_ffn_sublayer_sharded(
+                *args, s1, s2, mesh=mesh, rate_hidden=0.4))
+        np.testing.assert_allclose(sh_d[:2], plain_d[:2], rtol=1e-5,
+                                   atol=1e-6)
+        assert not np.allclose(sh_d[2:], plain_d[2:], atol=1e-6)
+
     def test_model_param_tree_identical_and_eval_equal(self):
         """ffn_impl='pallas' must keep the EXACT param tree of the flax
         path (checkpoints interchange) and agree at eval."""

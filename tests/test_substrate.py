@@ -151,11 +151,11 @@ def test_tricks_off_builds_unfused_reference_layout():
     assert rmodel.conv_remat is False and rmodel.dtype == jnp.float32
 
 
-def test_ffn_impl_pallas_falls_back_on_sharded_mesh(devices8):
-    """--ffn_impl pallas is single-chip only: build_model must fall back
-    to the flax composition (loudly) on ANY sharded mesh axis — tp, sp,
-    or dp alike (pallas_call does not SPMD-partition) — and keep the
-    kernel on an all-size-1 mesh."""
+def test_ffn_impl_pallas_mesh_routing(devices8):
+    """--ffn_impl pallas: data-sharded meshes (dp/fsdp/sp) keep the
+    kernel (shard_map per-shard path, mesh handed to the model); a
+    tp-sharded mesh falls back to flax loudly (tensor-parallel FFN
+    weights would be gathered per step)."""
     import warnings as _w
 
     from faster_distributed_training_tpu.cli import build_model
@@ -165,8 +165,9 @@ def test_ffn_impl_pallas_falls_back_on_sharded_mesh(devices8):
     cfg = TrainConfig(model="transformer", num_classes=4, seq_len=8,
                       n_layers=1, d_model=16, d_ff=32, n_heads=2,
                       ffn_impl="pallas")
-    for axes, shape, expect in ((("dp",), (8,), "flax"),
-                                (("dp", "sp"), (1, 8), "flax"),
+    for axes, shape, expect in ((("dp",), (8,), "pallas"),
+                                (("dp", "sp"), (1, 8), "pallas"),
+                                (("dp", "tp"), (1, 8), "flax"),
                                 (("dp",), (1,), "pallas")):
         mesh = make_mesh(axes, shape, devices8[:int(np.prod(shape))])
         with _w.catch_warnings(record=True) as rec:
@@ -174,7 +175,9 @@ def test_ffn_impl_pallas_falls_back_on_sharded_mesh(devices8):
             model = build_model(cfg, vocab_size=32, mesh=mesh)
         assert model.ffn_impl == expect, (axes, shape)
         if expect == "flax":
-            assert any("single-chip" in str(r.message) for r in rec)
+            assert any("tensor-parallel" in str(r.message) for r in rec)
+        elif any(s > 1 for s in shape):
+            assert model.mesh is mesh   # the sharded path needs the mesh
 
 
 def test_config_mesh_and_fsdp():
