@@ -249,15 +249,32 @@ def apply_subset(ds, stride: int):
 
 
 def resolve_attention(cfg: TrainConfig, mesh=None) -> str:
-    """'' auto-resolves: ring when the mesh has an sp axis of size > 1,
-    flash on TPU, dense otherwise."""
+    """'' auto-resolves: ring when the mesh has an sp axis of size > 1;
+    on TPU, DENSE at short sequences and flash beyond; dense off-TPU.
+
+    The short-sequence routing is measured, not assumed (r5, v5e,
+    bs256/seq256 NGD full step): once the dense path's prob dropout went
+    through the stateless hash engine (no threefry mask tensor), dense
+    measures 99.8 ms/step vs the flash kernel's 111.9 — at L=256 the
+    monolithic kernel's per-(b,h)-instance overhead exceeds XLA's batched
+    GEMM+softmax cost, while at L=512 flash wins (58.6 vs 69.6 ms at
+    bs64).  Dense materializes the [B,H,L,L] probs (bs256/seq256: peak
+    7.8 vs 6.2 GB — well inside HBM), so the crossover is routed on
+    seq_len; explicit --attention always wins."""
     if cfg.attention:
         return cfg.attention
     if (mesh is not None and "sp" in mesh.axis_names
             and mesh.shape["sp"] > 1):
         return "ring"
     import jax
-    return "flash" if jax.default_backend() == "tpu" else "dense"
+    if jax.default_backend() != "tpu":
+        return "dense"
+    # measured envelope only: the crossover and the +1.6 GB probs cost
+    # were measured at bs<=256/seq<=256 — larger batches scale the
+    # materialized [B,H,L,L] probs linearly in B and are unmeasured, so
+    # they keep flash (explicit --attention dense opts in regardless)
+    return ("dense" if cfg.seq_len <= 256 and cfg.batch_size <= 256
+            else "flash")
 
 
 def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,

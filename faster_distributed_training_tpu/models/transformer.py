@@ -148,10 +148,15 @@ class MultiheadAttention(nn.Module):
       ulysses — sequence-parallel all-to-all head/sequence swap over
               `sp_axis` (ops/ulysses_attention; needs h % sp == 0).
     EVERY impl applies attention-prob dropout in training
-    (transformer.py:190-192): dense uses jax.random.bernoulli on the
-    materialized probabilities; flash/ring/ulysses use the stateless
+    (transformer.py:190-192): flash/ring/ulysses use the stateless
     index-hash dropout (ops.attention.dropout_keep) computed inside the
-    kernel/scan, so the probability tensor still never touches HBM.
+    kernel/scan, so the probability tensor never touches HBM; dense
+    follows `dropout_impl` — hash (the default engine,
+    dense_attention_reference's in-place hash keep on the materialized
+    probs) or the reference's jax.random.bernoulli threefry mask when
+    dropout_impl != "hash" (the bag-of-tricks OFF arm sets
+    dropout_impl="xla" precisely to keep that reference-naive cost in
+    the ablation baseline).
     """
     h: int
     d_model: int
@@ -167,6 +172,7 @@ class MultiheadAttention(nn.Module):
                                       # 196-227) — the bag-of-tricks
                                       # ablation's unfused arm (different
                                       # param layout, ablation-only)
+    dropout_impl: str = "hash"        # prob-dropout engine for dense
 
     @nn.compact
     def __call__(self, x: jax.Array, mask: Optional[jax.Array],
@@ -190,11 +196,17 @@ class MultiheadAttention(nn.Module):
             q, k, v = proj("query"), proj("key"), proj("value")
         # training-path prob dropout for the never-materialized impls:
         # one fresh u32 hash seed per step from the dropout rng stream
-        drop_rate = self.dropout if (self.dropout > 0 and train) else 0.0
+        # dropout_impl "none" disables the attention-prob regularizer on
+        # EVERY impl (it is the all-dropout-off floor switch, not just
+        # the FastDropout sites' engine)
+        drop_rate = (self.dropout
+                     if (self.dropout > 0 and train
+                         and self.dropout_impl != "none") else 0.0)
+        use_hash = (self.attention_impl != "dense"
+                    or self.dropout_impl == "hash")
         drop_seed = (jax.random.bits(self.make_rng("dropout"),
                                      dtype=jnp.uint32)
-                     if drop_rate > 0 and self.attention_impl != "dense"
-                     else None)
+                     if drop_rate > 0 and use_hash else None)
         if self.attention_impl == "flash":
             from faster_distributed_training_tpu.ops.flash_attention import (
                 flash_attention)
@@ -216,10 +228,18 @@ class MultiheadAttention(nn.Module):
                                sp_axis=self.sp_axis,
                                dropout_rate=drop_rate,
                                dropout_seed=drop_seed)
+        elif use_hash:
+            # dense with the hash engine: same softmax-then-hash-keep
+            # semantics as every kernel path, no threefry mask tensor
+            from faster_distributed_training_tpu.ops.attention import (
+                dense_attention_reference)
+            ctx = dense_attention_reference(q, k, v, mask, drop_rate,
+                                            dropout_seed=drop_seed)
         else:
-            rng = (self.make_rng("dropout")
-                   if (self.dropout > 0 and train) else None)
-            ctx = dense_attention(q, k, v, mask, self.dropout,
+            # reference-naive arm (dropout_impl == "xla", e.g. --tricks
+            # off): materialized threefry bernoulli mask on the probs
+            rng = (self.make_rng("dropout") if drop_rate > 0 else None)
+            ctx = dense_attention(q, k, v, mask, drop_rate,
                                   deterministic=not train, dropout_rng=rng)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, L, self.d_model)
         # Name the attention context so the "attn_out" remat policy can
@@ -329,6 +349,7 @@ class EncoderLayer(nn.Module):
                                self.dtype, self.param_dtype,
                                self.attention_impl, self.mesh,
                                self.sp_axis, self.fused_qkv,
+                               dropout_impl=self.dropout_impl,
                                name="attn")(a, mask, train)
         a = FastDropout(self.dropout_connection_attention,
                         self.dropout_impl)(a, deterministic=not train)
@@ -339,8 +360,9 @@ class EncoderLayer(nn.Module):
             # zero FFN-shaped residuals (a capacity lever; see PARITY for
             # the measured time trade).  Param trees mirror the Flax path
             # exactly.  On sharded meshes the kernel runs PER SHARD via
-            # fused_ffn_sublayer_sharded (shard_map over the data axes,
-            # distinct per-shard mask streams); only tp SIZE > 1 falls
+            # fused_ffn_sublayer_sharded (shard_map over the data axes;
+            # each shard addresses the GLOBAL dropout index space, so
+            # masks are placement-invariant); only tp SIZE > 1 falls
             # back to Flax in build_model (gathering tensor-parallel FFN
             # weights per step would defeat tp).
             from faster_distributed_training_tpu.ops.fused_ffn import (
@@ -367,8 +389,8 @@ class EncoderLayer(nn.Module):
                            b2.astype(self.dtype), hid_seed, out_seed)
             if self.mesh is not None and any(
                     self.mesh.shape[ax] > 1 for ax in self.mesh.axis_names):
-                # SPMD: per-shard kernels over the data axes, distinct
-                # per-shard mask streams (ops/fused_ffn.py)
+                # SPMD: per-shard kernels over the data axes, masks
+                # addressed in the GLOBAL index space (ops/fused_ffn.py)
                 return fused_ffn_sublayer_sharded(
                     *kernel_args, mesh=self.mesh,
                     rate_hidden=r_h, rate_conn=r_c)

@@ -74,24 +74,36 @@ def hash_words(seed: jax.Array, n: int) -> jax.Array:
     return _fmix32(seed.astype(jnp.uint32) ^ lax.iota(jnp.uint32, n))
 
 
-def keep_factor_tile(seed: jax.Array, row0: jax.Array, rows: int, cols: int,
+def keep_factor_rows(seed: jax.Array, global_rows: jax.Array, cols: int,
                      rate: float) -> jax.Array:
-    """fp32 {0, GRID/t} keep factors for a (rows, cols) tile whose global
-    flat indices start at ``row0 * cols`` — THE single source of truth
-    for the hash-dropout mask stream.  ``row0=0`` over the full tensor
-    reproduces ``hash_dropout``'s mask exactly; Pallas kernels
-    (ops/fused_ffn.py) call it per row-block with the block's global row
-    offset, so in-kernel masks and the module-level engine agree by
-    construction."""
+    """fp32 {0, GRID/t} keep factors for a tile whose per-row GLOBAL row
+    ids are ``global_rows`` ((rows,) or (rows,1) u32) — THE single
+    source of truth for the hash-dropout mask stream: element (r, c)
+    keeps iff the top 16 hash bits of ``fmix(seed ^ (global_rows[r] *
+    cols + c))`` clear the rate threshold.  Explicit row ids let
+    sharded callers (ops/fused_ffn.py under shard_map) address the
+    GLOBAL index space even when their local rows are not globally
+    contiguous (sequence-sharded layouts) — masks depend only on
+    (seed, global position), never on device placement."""
     t = _thresh_u16(rate)
+    rows = int(np.shape(global_rows)[0])
     if t <= 0:   # rate within half a grid step of 1: drop everything
         return jnp.zeros((rows, cols), jnp.float32)
-    r = lax.broadcasted_iota(jnp.uint32, (rows, cols), 0)
     c = lax.broadcasted_iota(jnp.uint32, (rows, cols), 1)
-    idx = (row0.astype(jnp.uint32) + r) * jnp.uint32(cols) + c
+    idx = global_rows.astype(jnp.uint32).reshape(rows, 1) \
+        * jnp.uint32(cols) + c
     h16 = _fmix32(seed.astype(jnp.uint32) ^ idx) >> jnp.uint32(16)
     inv = np.float32(_GRID / t)  # exact-unbiasedness scale (realized keep)
     return jnp.where(h16 < jnp.uint32(t), inv, np.float32(0.0))
+
+
+def keep_factor_tile(seed: jax.Array, row0: jax.Array, rows: int, cols: int,
+                     rate: float) -> jax.Array:
+    """keep_factor_rows for a globally-CONTIGUOUS tile starting at row
+    ``row0``; ``row0=0`` over the full tensor reproduces
+    ``hash_dropout``'s mask exactly."""
+    r = row0.astype(jnp.uint32) + lax.iota(jnp.uint32, rows)
+    return keep_factor_rows(seed, r, cols, rate)
 
 
 def _keep_factor(seed: jax.Array, shape, rate: float) -> jax.Array:

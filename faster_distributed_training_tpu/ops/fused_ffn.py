@@ -51,7 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from faster_distributed_training_tpu.ops.dropout import keep_factor_tile
+from faster_distributed_training_tpu.ops.dropout import keep_factor_rows
 from faster_distributed_training_tpu.ops.layernorm import torch_layernorm_f32
 
 try:
@@ -90,8 +90,23 @@ _ln_f32 = torch_layernorm_f32
 
 
 # the mask stream lives in ops/dropout.py (one source of truth); this
-# module consumes it per row-block with the block's global row offset
-_keep_f32 = keep_factor_tile
+# module addresses it by GLOBAL row id (see _global_rows): masks depend
+# only on (seed, global position), never on sharding/placement
+_keep_rows = keep_factor_rows
+
+
+def _global_rows(r_local: jax.Array, b0, s0, l_loc: int,
+                 l_glob: int) -> jax.Array:
+    """Map LOCAL flattened row indices to GLOBAL row ids.
+
+    The (possibly sharded) activation is (B_local, L_local, d) flattened
+    to rows r = b_local * l_loc + s_local; the shard starts at batch
+    offset ``b0`` and sequence offset ``s0`` of a global (B, l_glob, d)
+    tensor.  Unsharded callers use the defaults b0=s0=0, l_loc=l_glob=1,
+    which reduce to g == r (the plain contiguous stream)."""
+    r = r_local.astype(jnp.uint32)
+    return ((jnp.uint32(b0) + r // jnp.uint32(l_loc)) * jnp.uint32(l_glob)
+            + jnp.uint32(s0) + r % jnp.uint32(l_loc))
 
 
 def ffn_sublayer_reference(h: jax.Array, ln_scale: jax.Array,
@@ -99,58 +114,67 @@ def ffn_sublayer_reference(h: jax.Array, ln_scale: jax.Array,
                            w2: jax.Array, b2: jax.Array,
                            hid_seed: jax.Array, out_seed: jax.Array,
                            rate_hidden: float, rate_conn: float,
-                           eps: float = 1e-6) -> jax.Array:
+                           eps: float = 1e-6, b0=0, s0=0,
+                           l_loc: int = 1, l_glob: int = 1) -> jax.Array:
     """Pure-XLA oracle with the kernel's exact op order and dtypes.
     Weights in Flax Dense layout (in, out).  Also the bwd math source:
-    the custom_vjp backward is jax.vjp of THIS function."""
+    the custom_vjp backward is jax.vjp of THIS function.  b0/s0/l_loc/
+    l_glob address the global dropout index space for sharded callers
+    (defaults = unsharded)."""
     lead = h.shape[:-1]
     d = h.shape[-1]
     x32 = h.reshape(-1, d).astype(jnp.float32)
+    n_rows = x32.shape[0]
+    grows = _global_rows(lax.iota(jnp.uint32, n_rows), b0, s0, l_loc, l_glob)
     f = _ln_f32(x32, ln_scale.astype(jnp.float32),
                 ln_bias.astype(jnp.float32), eps).astype(h.dtype)
     h1 = jnp.dot(f, w1, preferred_element_type=jnp.float32) \
         + b1.astype(jnp.float32)
     a = _gelu_f32(h1)
     if rate_hidden > 0.0:
-        n_rows = a.shape[0]
-        a = a * _keep_f32(hid_seed, jnp.uint32(0), n_rows, a.shape[1],
-                          rate_hidden)
+        a = a * _keep_rows(hid_seed, grows, a.shape[1], rate_hidden)
     a = a.astype(h.dtype)
     f2 = jnp.dot(a, w2, preferred_element_type=jnp.float32) \
         + b2.astype(jnp.float32)
     if rate_conn > 0.0:
-        f2 = f2 * _keep_f32(out_seed, jnp.uint32(0), f2.shape[0],
-                            f2.shape[1], rate_conn)
+        f2 = f2 * _keep_rows(out_seed, grows, f2.shape[1], rate_conn)
     out = x32 + f2
     return out.astype(h.dtype).reshape(*lead, d)
 
 
 def _ffn_kernel(h_ref, lns_ref, lnb_ref, w1_ref, b1_ref, w2_ref, b2_ref,
                 seeds_ref, o_ref, *, block_rows: int,
-                rate_hidden: float, rate_conn: float, eps: float):
+                rate_hidden: float, rate_conn: float, eps: float,
+                l_loc: int, l_glob: int):
     row0 = pl.program_id(0) * block_rows
     x32 = h_ref[...].astype(jnp.float32)
+    rows = x32.shape[0]
     f = _ln_f32(x32, lns_ref[...].astype(jnp.float32),
                 lnb_ref[...].astype(jnp.float32), eps).astype(h_ref.dtype)
     h1 = jax.lax.dot(f, w1_ref[...],
                      preferred_element_type=jnp.float32) \
         + b1_ref[...].astype(jnp.float32)
     a = _gelu_f32(h1)
+    if rate_hidden > 0.0 or rate_conn > 0.0:
+        # (rows, 1) — Mosaic wants >=2D iota; keep_factor_rows reshapes
+        r_local = (jnp.uint32(row0)
+                   + lax.broadcasted_iota(jnp.uint32, (rows, 1), 0))
+        grows = _global_rows(r_local, seeds_ref[0, 2], seeds_ref[0, 3],
+                             l_loc, l_glob)
     if rate_hidden > 0.0:
-        a = a * _keep_f32(seeds_ref[0, 0], jnp.uint32(row0), a.shape[0],
-                          a.shape[1], rate_hidden)
+        a = a * _keep_rows(seeds_ref[0, 0], grows, a.shape[1], rate_hidden)
     a = a.astype(h_ref.dtype)
     f2 = jax.lax.dot(a, w2_ref[...],
                      preferred_element_type=jnp.float32) \
         + b2_ref[...].astype(jnp.float32)
     if rate_conn > 0.0:
-        f2 = f2 * _keep_f32(seeds_ref[0, 1], jnp.uint32(row0), f2.shape[0],
-                            f2.shape[1], rate_conn)
+        f2 = f2 * _keep_rows(seeds_ref[0, 1], grows, f2.shape[1], rate_conn)
     o_ref[...] = (x32 + f2).astype(o_ref.dtype)
 
 
 def _ffn_fwd_pallas(h2d, ln_scale, ln_bias, w1, b1, w2, b2, seeds,
-                    rate_hidden, rate_conn, eps, block_rows=256):
+                    rate_hidden, rate_conn, eps, l_loc, l_glob,
+                    block_rows=256):
     B, d = h2d.shape
     d_ff = w1.shape[1]
     block_rows = min(block_rows, B)
@@ -162,7 +186,7 @@ def _ffn_fwd_pallas(h2d, ln_scale, ln_bias, w1, b1, w2, b2, seeds,
         h2d = jnp.pad(h2d, ((0, pad), (0, 0)))
     kern = functools.partial(_ffn_kernel, block_rows=block_rows,
                              rate_hidden=rate_hidden, rate_conn=rate_conn,
-                             eps=eps)
+                             eps=eps, l_loc=l_loc, l_glob=l_glob)
     out = pl.pallas_call(
         kern,
         grid=(nb,),
@@ -174,7 +198,7 @@ def _ffn_fwd_pallas(h2d, ln_scale, ln_bias, w1, b1, w2, b2, seeds,
             pl.BlockSpec((1, d_ff), lambda i: (0, 0)),
             pl.BlockSpec((d_ff, d), lambda i: (0, 0)),
             pl.BlockSpec((1, d), lambda i: (0, 0)),
-            pl.BlockSpec((1, 2), lambda i: (0, 0)),
+            pl.BlockSpec((1, 4), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((nb * block_rows, d), h2d.dtype),
@@ -184,7 +208,49 @@ def _ffn_fwd_pallas(h2d, ln_scale, ln_bias, w1, b1, w2, b2, seeds,
     return out[:B] if pad else out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(11, 12, 13, 14, 15))
+def _ffn_core(h, ln_scale, ln_bias, w1, b1, w2, b2,
+              hid_seed, out_seed, b0, s0,
+              rate_hidden: float, rate_conn: float, eps: float,
+              l_loc: int, l_glob: int):
+    lead = h.shape[:-1]
+    d = h.shape[-1]
+    seeds = jnp.stack([jnp.asarray(hid_seed, jnp.uint32),
+                       jnp.asarray(out_seed, jnp.uint32),
+                       jnp.asarray(b0, jnp.uint32),
+                       jnp.asarray(s0, jnp.uint32)]).reshape(1, 4)
+    out = _ffn_fwd_pallas(h.reshape(-1, d), ln_scale, ln_bias, w1, b1,
+                          w2, b2, seeds, rate_hidden, rate_conn, eps,
+                          l_loc, l_glob)
+    return out.reshape(*lead, d)
+
+
+def _ffn_vjp_fwd(h, ln_scale, ln_bias, w1, b1, w2, b2, hid_seed, out_seed,
+                 b0, s0, rate_hidden, rate_conn, eps, l_loc, l_glob):
+    out = _ffn_core(h, ln_scale, ln_bias, w1, b1, w2, b2,
+                    hid_seed, out_seed, b0, s0,
+                    rate_hidden, rate_conn, eps, l_loc, l_glob)
+    # residuals: INPUTS only — nothing FFN-shaped is saved (the whole
+    # sublayer is recomputed by the reference fn inside the bwd vjp)
+    return out, (h, ln_scale, ln_bias, w1, b1, w2, b2, hid_seed, out_seed,
+                 b0, s0)
+
+
+def _ffn_vjp_bwd(rate_hidden, rate_conn, eps, l_loc, l_glob, res, g):
+    (h, ln_scale, ln_bias, w1, b1, w2, b2, hid_seed, out_seed,
+     b0, s0) = res
+    _, vjp = jax.vjp(
+        lambda h_, s_, bi_, w1_, b1_, w2_, b2_: ffn_sublayer_reference(
+            h_, s_, bi_, w1_, b1_, w2_, b2_, hid_seed, out_seed,
+            rate_hidden, rate_conn, eps, b0, s0, l_loc, l_glob),
+        h, ln_scale, ln_bias, w1, b1, w2, b2)
+    zero = np.zeros((), jax.dtypes.float0)
+    return (*vjp(g), zero, zero, zero, zero)
+
+
+_ffn_core.defvjp(_ffn_vjp_fwd, _ffn_vjp_bwd)
+
+
 def fused_ffn_sublayer(h, ln_scale, ln_bias, w1, b1, w2, b2,
                        hid_seed, out_seed,
                        rate_hidden: float = 0.0, rate_conn: float = 0.0,
@@ -193,37 +259,11 @@ def fused_ffn_sublayer(h, ln_scale, ln_bias, w1, b1, w2, b2,
     kernel (see module docstring).  h: (..., d_model); weights in Flax
     (in, out) layout; seeds: u32 scalars (ignored when the static rates
     are 0 — pass anything).  Gradients flow to h, LN params, weights and
-    biases; seeds are non-differentiable."""
-    lead = h.shape[:-1]
-    d = h.shape[-1]
-    seeds = jnp.stack([jnp.asarray(hid_seed, jnp.uint32),
-                       jnp.asarray(out_seed, jnp.uint32)]).reshape(1, 2)
-    out = _ffn_fwd_pallas(h.reshape(-1, d), ln_scale, ln_bias, w1, b1,
-                          w2, b2, seeds, rate_hidden, rate_conn, eps)
-    return out.reshape(*lead, d)
-
-
-def _ffn_vjp_fwd(h, ln_scale, ln_bias, w1, b1, w2, b2, hid_seed, out_seed,
-                 rate_hidden, rate_conn, eps):
-    out = fused_ffn_sublayer(h, ln_scale, ln_bias, w1, b1, w2, b2,
-                             hid_seed, out_seed, rate_hidden, rate_conn, eps)
-    # residuals: INPUTS only — nothing FFN-shaped is saved (the whole
-    # sublayer is recomputed by the reference fn inside the bwd vjp)
-    return out, (h, ln_scale, ln_bias, w1, b1, w2, b2, hid_seed, out_seed)
-
-
-def _ffn_vjp_bwd(rate_hidden, rate_conn, eps, res, g):
-    h, ln_scale, ln_bias, w1, b1, w2, b2, hid_seed, out_seed = res
-    _, vjp = jax.vjp(
-        lambda h_, s_, bi_, w1_, b1_, w2_, b2_: ffn_sublayer_reference(
-            h_, s_, bi_, w1_, b1_, w2_, b2_, hid_seed, out_seed,
-            rate_hidden, rate_conn, eps),
-        h, ln_scale, ln_bias, w1, b1, w2, b2)
-    zero = np.zeros((), jax.dtypes.float0)
-    return (*vjp(g), zero, zero)
-
-
-fused_ffn_sublayer.defvjp(_ffn_vjp_fwd, _ffn_vjp_bwd)
+    biases; seeds are non-differentiable.  Dropout indices are the plain
+    contiguous stream (global offsets are the sharded wrapper's job)."""
+    return _ffn_core(h, ln_scale, ln_bias, w1, b1, w2, b2,
+                     hid_seed, out_seed, jnp.uint32(0), jnp.uint32(0),
+                     rate_hidden, rate_conn, eps, 1, 1)
 
 
 def fused_ffn_sublayer_sharded(h, ln_scale, ln_bias, w1, b1, w2, b2,
@@ -235,16 +275,15 @@ def fused_ffn_sublayer_sharded(h, ln_scale, ln_bias, w1, b1, w2, b2,
     over the mesh's data axes (batch over dp/fsdp, sequence over sp),
     weights replicated (an fsdp/ZeRO-3-sharded weight is all-gathered by
     the partitioner at the shard_map boundary — the same gather the Flax
-    path's dot would trigger).  Each shard folds its linearized data-axis
-    index into the dropout seeds (murmur3-mixed, inside the shard_map so
-    the custom_vjp backward sees the identical per-shard seeds), so
-    shards draw DISTINCT mask streams instead of repeating one local
-    pattern per device.  tp-sharded FFN weights remain unsupported
-    (build_model falls back — gathering tensor-parallel weights per step
-    would defeat tp)."""
+    path's dot would trigger).  Each shard addresses the GLOBAL dropout
+    index space through its (batch, sequence) offsets — the same
+    placement-invariance convention as every other sharded dropout
+    consumer (ops/attention.py dropout_keep): masks depend only on
+    (seed, global position), so the SAME global batch draws the SAME
+    masks on dp=1, dp=4 or dp=8, bit-for-bit.  tp-sharded FFN weights
+    remain unsupported (build_model falls back — gathering
+    tensor-parallel weights per step would defeat tp)."""
     from jax.sharding import PartitionSpec as P
-
-    from faster_distributed_training_tpu.ops.attention import _fmix32
 
     batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names
                        and mesh.shape[a] > 1)
@@ -254,21 +293,26 @@ def fused_ffn_sublayer_sharded(h, ln_scale, ln_bias, w1, b1, w2, b2,
         return fused_ffn_sublayer(h, ln_scale, ln_bias, w1, b1, w2, b2,
                                   hid_seed, out_seed, rate_hidden,
                                   rate_conn, eps)
+    if h.ndim != 3:
+        raise ValueError("fused_ffn_sublayer_sharded expects (B, L, d) "
+                         f"activations, got shape {h.shape}")
     data_spec = P(batch_axes if len(batch_axes) != 1 else batch_axes[0],
                   seq_axis, None)
     rep = P(None)
+    sp_size = mesh.shape[seq_axis] if seq_axis else 1
 
     def per_shard(h_, lns_, lnb_, w1_, b1_, w2_, b2_, s1_, s2_):
-        ix = jnp.uint32(0)
-        for ax in batch_axes + ((seq_axis,) if seq_axis else ()):
-            ix = ix * jnp.uint32(mesh.shape[ax]) \
+        b_loc, l_loc = h_.shape[0], h_.shape[1]
+        bi = jnp.uint32(0)
+        for ax in batch_axes:
+            bi = bi * jnp.uint32(mesh.shape[ax]) \
                 + jax.lax.axis_index(ax).astype(jnp.uint32)
-        # distinct per-shard streams; shard 0 keeps the unsharded stream
-        # (_fmix32(0) == 0), so 1-device meshes match the plain kernel
-        mix = _fmix32(ix)
-        return fused_ffn_sublayer(h_, lns_, lnb_, w1_, b1_, w2_, b2_,
-                                  s1_ ^ mix, s2_ ^ mix,
-                                  rate_hidden, rate_conn, eps)
+        b0 = bi * jnp.uint32(b_loc)
+        s0 = (jax.lax.axis_index(seq_axis).astype(jnp.uint32)
+              * jnp.uint32(l_loc) if seq_axis else jnp.uint32(0))
+        return _ffn_core(h_, lns_, lnb_, w1_, b1_, w2_, b2_, s1_, s2_,
+                         b0, s0, rate_hidden, rate_conn, eps,
+                         l_loc, l_loc * sp_size)
 
     return jax.shard_map(
         per_shard, mesh=mesh,

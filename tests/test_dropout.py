@@ -227,6 +227,42 @@ class TestFastDropoutModule:
         assert (a != b).any()
 
 
+class TestDenseAttentionDropoutRouting:
+    """The dense attention path follows `dropout_impl` for its PROB
+    dropout (round 5): hash routes through dense_attention_reference's
+    in-place hash keep (no threefry mask tensor); any other engine keeps
+    the reference-naive bernoulli path — the bag-of-tricks OFF arm
+    (dropout_impl='xla') must retain that cost."""
+
+    def _run(self, impl, monkeypatch):
+        from faster_distributed_training_tpu.models import Transformer
+        from faster_distributed_training_tpu.ops import attention as A
+
+        calls = []
+        orig = A.dense_attention_reference
+        monkeypatch.setattr(
+            A, "dense_attention_reference",
+            lambda *a, **k: calls.append(1) or orig(*a, **k))
+        model = Transformer(n_class=4, vocab=64, n_layers=1, h=2,
+                            d_model=16, d_ff=32, d_hidden=16, maxlen=8,
+                            attention_impl="dense", dropout_impl=impl)
+        x = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, size=(4, 8)), jnp.int32)
+        rng = jax.random.PRNGKey(0)
+        v = model.init({"params": rng, "dropout": rng, "mixup": rng},
+                       x, train=True)
+        model.apply({"params": v["params"]}, x, train=True,
+                    rngs={"dropout": jax.random.PRNGKey(1),
+                          "mixup": jax.random.PRNGKey(2)})
+        return len(calls)
+
+    def test_hash_engine_uses_reference_hash_path(self, monkeypatch):
+        assert self._run("hash", monkeypatch) > 0
+
+    def test_xla_engine_keeps_bernoulli_path(self, monkeypatch):
+        assert self._run("xla", monkeypatch) == 0
+
+
 class TestTransformerHashDropout:
     def test_transformer_trains_with_hash_dropout(self):
         """Default transformer fwd+bwd with dropout_impl=hash: loss finite,

@@ -261,27 +261,40 @@ class TestFusedFFNSublayer:
 
     def test_dropout_stream_matches_hash_dropout(self):
         """The in-kernel masks must equal ops.dropout.hash_dropout on the
-        full tensor (same (seed, flat-index) stream), so backward
+        full tensor (same (seed, global-index) stream), so backward
         regeneration and the module-level engine agree — including at a
-        NONZERO row offset (the per-block path real shapes exercise)."""
-        from faster_distributed_training_tpu.ops.dropout import hash_dropout
-        from faster_distributed_training_tpu.ops.fused_ffn import _keep_f32
+        NONZERO row offset and through the sharded _global_rows mapping."""
+        from faster_distributed_training_tpu.ops.dropout import (
+            hash_dropout, keep_factor_rows, keep_factor_tile)
+        from faster_distributed_training_tpu.ops.fused_ffn import (
+            _global_rows)
 
         seed = jnp.uint32(77)
         rows, cols = 16, 32
         ones = jnp.ones((rows, cols), jnp.float32)
-        via_kernel = np.asarray(
-            ones * _keep_f32(seed, jnp.uint32(0), rows, cols, 0.3))
+        via_tile = np.asarray(
+            ones * keep_factor_tile(seed, jnp.uint32(0), rows, cols, 0.3))
         via_module = np.asarray(hash_dropout(ones, seed, 0.3))
-        np.testing.assert_array_equal(via_kernel, via_module)
+        np.testing.assert_array_equal(via_tile, via_module)
         # row0=6: the tile must reproduce rows 6.. of the full stream
         tail = np.asarray(jnp.ones((rows - 6, cols), jnp.float32)
-                          * _keep_f32(seed, jnp.uint32(6), rows - 6, cols,
-                                      0.3))
+                          * keep_factor_tile(seed, jnp.uint32(6), rows - 6,
+                                             cols, 0.3))
         np.testing.assert_array_equal(tail, via_module[6:])
+        # the sharded global-rows mapping: a (B=4, L=4) shard at batch
+        # offset 2, seq offset 0 of an L_glob=8 tensor addresses rows
+        # {(2+b)*8 + s} of the global stream
+        g = _global_rows(jnp.arange(8, dtype=jnp.uint32), b0=2, s0=0,
+                         l_loc=4, l_glob=8)
+        expect = [(2 + r // 4) * 8 + r % 4 for r in range(8)]
+        np.testing.assert_array_equal(np.asarray(g), expect)
+        shard = np.asarray(keep_factor_rows(seed, g, cols, 0.3))
+        full = np.asarray(keep_factor_tile(seed, jnp.uint32(0), 40, cols,
+                                           0.3))
+        np.testing.assert_array_equal(shard, full[np.asarray(expect)])
         # rate ~1 drops everything instead of dividing by zero
-        assert float(np.abs(_keep_f32(seed, jnp.uint32(0), 4, 8,
-                                      1.0 - 1e-9)).max()) == 0.0
+        assert float(np.abs(keep_factor_tile(
+            seed, jnp.uint32(0), 4, 8, 1.0 - 1e-9)).max()) == 0.0
 
     def test_multi_block_grid_and_padding(self):
         """Rows > block_rows exercise the grid>1 path (per-block row0
@@ -317,44 +330,45 @@ class TestFusedFFNSublayer:
         assert float(err.max()) < 1e-6
 
     def test_sharded_wrapper_matches_unsharded(self, devices8):
-        """fused_ffn_sublayer_sharded on an 8-way dp mesh: without
-        dropout the per-shard kernels must reproduce the unsharded
-        output and gradients exactly (pure math, batch-split); with
-        dropout, shard 0's rows keep the unsharded stream (the seed mix
-        folds in _fmix32(shard_index) and _fmix32(0) == 0) while other
-        shards draw DISTINCT streams."""
+        """fused_ffn_sublayer_sharded is PLACEMENT-INVARIANT (the
+        codebase's sharded-dropout convention, ops/attention.py
+        dropout_keep): per-shard kernels address the GLOBAL dropout
+        index space through their (batch, seq) offsets, so the same
+        global batch reproduces the unsharded output and gradients
+        exactly — WITH dropout active, on batch-sharded and
+        sequence-sharded meshes alike."""
         from faster_distributed_training_tpu.ops.fused_ffn import (
             fused_ffn_sublayer, fused_ffn_sublayer_sharded)
         from faster_distributed_training_tpu.parallel import make_mesh
 
-        mesh = make_mesh(("dp",), (8,), devices8)
         args = self._inputs(B=16)
         s1, s2 = jnp.uint32(3), jnp.uint32(4)
-
         plain = fused_ffn_sublayer(*args, s1, s2, 0.0, 0.0)
-        with mesh:
-            sh = fused_ffn_sublayer_sharded(*args, s1, s2, mesh=mesh)
-        np.testing.assert_allclose(np.asarray(sh), np.asarray(plain),
-                                   rtol=1e-5, atol=1e-6)
-
+        plain_d = np.asarray(fused_ffn_sublayer(*args, s1, s2, 0.4, 0.3))
         gp = jax.grad(lambda h: jnp.sum(
-            fused_ffn_sublayer(h, *args[1:], s1, s2, 0.0, 0.0) ** 2))(args[0])
-        with mesh:
-            gs = jax.grad(lambda h: jnp.sum(
-                fused_ffn_sublayer_sharded(h, *args[1:], s1, s2,
-                                           mesh=mesh) ** 2))(args[0])
-        np.testing.assert_allclose(np.asarray(gs), np.asarray(gp),
-                                   rtol=1e-4, atol=1e-5)
+            fused_ffn_sublayer(h, *args[1:], s1, s2, 0.4, 0.3) ** 2))(args[0])
 
-        # dropout on: per-shard streams — shard 0 (batch rows 0-1)
-        # matches the plain kernel on ITS rows; some later shard differs
-        plain_d = np.asarray(fused_ffn_sublayer(*args, s1, s2, 0.4, 0.0))
-        with mesh:
-            sh_d = np.asarray(fused_ffn_sublayer_sharded(
-                *args, s1, s2, mesh=mesh, rate_hidden=0.4))
-        np.testing.assert_allclose(sh_d[:2], plain_d[:2], rtol=1e-5,
-                                   atol=1e-6)
-        assert not np.allclose(sh_d[2:], plain_d[2:], atol=1e-6)
+        for axes, shape in ((("dp",), (8,)), (("dp", "sp"), (2, 4))):
+            mesh = make_mesh(axes, shape, devices8)
+            with mesh:
+                sh = fused_ffn_sublayer_sharded(*args, s1, s2, mesh=mesh)
+                sh_d = np.asarray(fused_ffn_sublayer_sharded(
+                    *args, s1, s2, mesh=mesh, rate_hidden=0.4,
+                    rate_conn=0.3))
+                gs = jax.grad(lambda h: jnp.sum(
+                    fused_ffn_sublayer_sharded(h, *args[1:], s1, s2,
+                                               mesh=mesh, rate_hidden=0.4,
+                                               rate_conn=0.3) ** 2))(args[0])
+            np.testing.assert_allclose(np.asarray(sh), np.asarray(plain),
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg=str(axes))
+            np.testing.assert_array_equal(
+                sh_d == 0.0, plain_d == 0.0)   # identical drop pattern
+            np.testing.assert_allclose(sh_d, plain_d, rtol=1e-5, atol=1e-6,
+                                       err_msg=str(axes))
+            np.testing.assert_allclose(np.asarray(gs), np.asarray(gp),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=str(axes))
 
     def test_model_param_tree_identical_and_eval_equal(self):
         """ffn_impl='pallas' must keep the EXACT param tree of the flax

@@ -151,6 +151,31 @@ def test_tricks_off_builds_unfused_reference_layout():
     assert rmodel.conv_remat is False and rmodel.dtype == jnp.float32
 
 
+def test_resolve_attention_seq_length_routing(monkeypatch, devices8):
+    """'' auto-resolution (r5, measured crossover): dense at seq<=256 on
+    TPU (99.8 vs 111.9 ms/step at bs256/seq256 once dense prob-dropout
+    went through the hash engine), flash beyond, ring under an sp axis,
+    dense off-TPU; explicit --attention always wins."""
+    from faster_distributed_training_tpu.cli import resolve_attention
+    from faster_distributed_training_tpu.config import TrainConfig
+    from faster_distributed_training_tpu.parallel import make_mesh
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert resolve_attention(
+        TrainConfig(seq_len=256, batch_size=256)) == "dense"
+    assert resolve_attention(
+        TrainConfig(seq_len=512, batch_size=256)) == "flash"
+    # outside the measured envelope (probs memory scales with B): flash
+    assert resolve_attention(
+        TrainConfig(seq_len=256, batch_size=1024)) == "flash"
+    assert resolve_attention(TrainConfig(seq_len=512,
+                                         attention="dense")) == "dense"
+    sp_mesh = make_mesh(("dp", "sp"), (1, 8), devices8)
+    assert resolve_attention(TrainConfig(seq_len=2048), sp_mesh) == "ring"
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert resolve_attention(TrainConfig(seq_len=512)) == "dense"
+
+
 def test_ffn_impl_pallas_mesh_routing(devices8):
     """--ffn_impl pallas: data-sharded meshes (dp/fsdp/sp) keep the
     kernel (shard_map per-shard path, mesh handed to the model); a
