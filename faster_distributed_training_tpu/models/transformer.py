@@ -228,7 +228,7 @@ class MultiheadAttention(nn.Module):
                                sp_axis=self.sp_axis,
                                dropout_rate=drop_rate,
                                dropout_seed=drop_seed)
-        elif use_hash:
+        elif use_hash and drop_rate > 0:
             # dense with the hash engine: same softmax-then-hash-keep
             # semantics as every kernel path, no threefry mask tensor
             from faster_distributed_training_tpu.ops.attention import (
@@ -236,8 +236,11 @@ class MultiheadAttention(nn.Module):
             ctx = dense_attention_reference(q, k, v, mask, drop_rate,
                                             dropout_seed=drop_seed)
         else:
-            # reference-naive arm (dropout_impl == "xla", e.g. --tricks
-            # off): materialized threefry bernoulli mask on the probs
+            # dropout inactive (eval / rate 0): ONE dense path for every
+            # engine, so a training-only flag cannot shift inference
+            # numerics; with dropout active this is the reference-naive
+            # arm (dropout_impl == "xla", e.g. --tricks off):
+            # materialized threefry bernoulli mask on the probs
             rng = (self.make_rng("dropout") if drop_rate > 0 else None)
             ctx = dense_attention(q, k, v, mask, drop_rate,
                                   deterministic=not train, dropout_rng=rng)
