@@ -33,6 +33,21 @@ full-pipeline tricks-speedup keys, a `baseline_note`, and the
 the previous round's BENCH_r*.json and >5% moves in the harmful
 direction are flagged in-record.
 
+Round-6 additions (VERDICT r5 #1/#2/#5/#7): the EVIDENCE CHAIN — the
+full record is persisted to the committed BENCH_LATEST.json every run
+and a compact <=1.5 KB essentials line prints LAST so the driver's 2 KB
+stdout tail always parses (the r5 record was lost to tail truncation);
+`_prev_bench_record` now skips unparseable driver wrappers and prefers
+the newest parseable record.  The flagged bs64/seq512 and
+tricks-transformer metrics are measured N>=5 times INTERLEAVED with
+medians published plus *_noise_band_pct fields that feed the guard's
+thresholds.  New arms: the 2D dense/flash crossover cells
+(ATTN_ROUTE_BENCH_CELLS -> attn_route_*_step_ms), eval throughput
+through the real pad-and-mask eval step (resnet_eval_img_per_sec_*,
+transformer_eval_ex_per_sec_*), per-arm transformer_*_step_ms, and the
+tentpole A/B attribution arms (transformer_bs256_seq256_ln_autodiff_
+step_ms, transformer_bs64_seq512_flash_recompute_step_ms).
+
 Baseline: the reference publishes no absolute throughput (BASELINE.md).
 `vs_baseline` is value / FDT_BENCH_BASELINE (img/s/chip) when that env
 var is set; otherwise the constant 1.0 with "baseline_configured": false
@@ -61,6 +76,18 @@ import numpy as np
 # bs=1024 with AMP+fusion is not published (BASELINE.md); the driver tracks
 # our absolute number round-over-round. Overridable bookkeeping constant:
 BASELINE_REF_IPS = float(os.environ.get("FDT_BENCH_BASELINE", "0") or 0)
+
+# 2D dense/flash crossover arms (VERDICT r5 #5): (bs, seq, impls) cells
+# measured per round as attn_route_bs{bs}_seq{seq}_{impl}_step_ms.
+# cli._ATTN_ROUTE_SURFACE cites these cells per routed region;
+# tests/test_substrate.py pins the correspondence.  bs1024/seq256 runs
+# flash only — its dense arm is excluded by the routing memory bound
+# (see the note emitted beside it).
+ATTN_ROUTE_BENCH_CELLS = ((512, 128, ("dense", "flash")),
+                          (1024, 128, ("dense", "flash")),
+                          (512, 256, ("dense", "flash")),
+                          (1024, 256, ("flash",)),
+                          (256, 384, ("dense", "flash")))
 
 
 def _fence(metrics) -> None:
@@ -352,30 +379,83 @@ def timed_attention_ladder(steps: int = 30) -> dict:
     return out
 
 
+BENCH_LATEST = "BENCH_LATEST.json"
+
+
+def _bench_dir() -> str:
+    return os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_bench_record(path):
+    """One bench artifact -> metric record, or None.  Handles the
+    committed full record (BENCH_LATEST.json), the driver wrapper
+    {n, cmd, rc, tail, parsed} — using `parsed` when it is a dict, else
+    scanning the captured tail for a parseable JSON line — and a bare
+    record.  A wrapper whose tail is a truncated mid-record fragment
+    (the r5 failure mode, VERDICT r5 #1) yields None instead of the
+    metric-less wrapper itself."""
+    try:
+        with open(path) as fh:
+            rec = json.load(fh)
+    except Exception:
+        return None
+    if not isinstance(rec, dict):
+        return None
+    if "tail" in rec or "parsed" in rec:          # driver wrapper
+        parsed = rec.get("parsed")
+        if isinstance(parsed, dict):
+            return parsed
+        for line in reversed(str(rec.get("tail", "")).splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    cand = json.loads(line)
+                except Exception:
+                    continue
+                if isinstance(cand, dict) and ("value" in cand
+                                               or "essentials" in cand):
+                    return cand
+        return None
+    if "value" in rec or "metric" in rec or "essentials" in rec:
+        return rec
+    return None
+
+
 def _prev_bench_record():
-    """(record, filename) from the highest-numbered BENCH_r*.json beside
-    this script, or (None, None) — the round-over-round regression guard
-    (VERDICT r4 #2c)."""
+    """(record, filename) for the round-over-round regression guard
+    (VERDICT r4 #2c, repaired per VERDICT r5 #1): the NEWEST parseable
+    record among the driver-captured BENCH_r*.json wrappers and the
+    committed BENCH_LATEST.json (written by bench itself every run so a
+    truncated driver tail can never orphan a round again).  Unparseable
+    wrappers (r5's `parsed: null` mid-record fragment) are skipped, not
+    returned.  Newness = (bench_unix_time, full-record-over-essentials,
+    round number); when the newest driver tail carries only the compact
+    essentials line of the same run, BENCH_LATEST's full record wins the
+    tie on bench_unix_time."""
     import glob
     import re as _re
 
-    here = os.path.dirname(os.path.abspath(__file__))
-    best, best_n = None, -1
+    here = _bench_dir()
+    candidates = []   # (time, is_full, round_rank, rec, name)
     for f in glob.glob(os.path.join(here, "BENCH_r*.json")):
         m = _re.search(r"BENCH_r(\d+)\.json$", f)
-        if m and int(m.group(1)) > best_n:
-            best, best_n = f, int(m.group(1))
-    if not best:
+        if not m:
+            continue
+        rec = _load_bench_record(f)
+        if rec is None:
+            continue
+        candidates.append((float(rec.get("bench_unix_time", 0) or 0),
+                           0 if rec.get("essentials") else 1,
+                           int(m.group(1)), rec, os.path.basename(f)))
+    latest = _load_bench_record(os.path.join(here, BENCH_LATEST))
+    if latest is not None:
+        candidates.append((float(latest.get("bench_unix_time", 0) or 0),
+                           0 if latest.get("essentials") else 1,
+                           1 << 30, latest, BENCH_LATEST))
+    if not candidates:
         return None, None
-    try:
-        with open(best) as fh:
-            rec = json.load(fh)
-        # the driver wraps the bench line: {n, cmd, rc, tail, parsed}
-        if isinstance(rec.get("parsed"), dict):
-            rec = rec["parsed"]
-        return rec, os.path.basename(best)
-    except Exception:
-        return None, None
+    _, _, _, rec, name = max(candidates, key=lambda c: c[:3])
+    return rec, name
 
 
 # tracked-metric direction rules for the regression guard: a move the
@@ -386,7 +466,8 @@ def _prev_bench_record():
 # >10% variance on the attention ladder and ±1 percentage point on the
 # NGD-overhead ratio; throughputs are stable to well under 5%).
 _HIGHER_IS_BETTER = ("value", "tricks_speedup", "ex_per_sec",
-                     "achieved_tflops", "mfu_pct", "gemm_ceiling")
+                     "img_per_sec", "achieved_tflops", "mfu_pct",
+                     "gemm_ceiling")
 _LOWER_IS_BETTER = ("attn_fwdbwd_ms", "peak_mem_bytes", "step_ms")
 _REL_THRESHOLD = {"attn_fwdbwd_ms": 0.25,   # ladder: >10% tunnel variance
                   "step_ms": 0.10,          # per-step times: modest noise
@@ -404,6 +485,12 @@ _EXPECTED_MOVES = {
         "intentional r5 trade: auto-routed dense attention materializes "
         "the [B,H,L,L] probs (~+1.6 GB) for +13-15% throughput at this "
         "config (PARITY.md, resolve_attention)"),
+    "transformer_bs64_seq512_peak_mem_bytes": (
+        "intentional r6 trade: the monolithic flash forward now emits "
+        "the row lse as a backward residual (saved-stats backward skips "
+        "the in-kernel softmax recompute, ops/flash_attention.py); the "
+        "128-lane lse buffer costs ~130 MB transient at this shape — "
+        "FDT_FLASH_SAVE_STATS=0 restores the recompute backward"),
     "ngd_overhead_pct": (
         "tunnel-noise-sensitive ratio; diagnose with the absolute "
         "resnet_{ngd,sgd}_step_ms arms published beside it"),
@@ -427,12 +514,15 @@ def _find_regressions(record: dict, prev: dict, check_missing: bool = True):
         for key, was in prev.items():
             if (isinstance(was, (int, float)) and not isinstance(was, bool)
                     and key not in record
+                    and not key.endswith("_noise_band_pct")
                     and any(p in key for p in tracked)):
                 out.append({"metric": key, "prev": was, "now": None,
                             "missing": True})
     same_config = record.get("metric") == prev.get("metric")
     for key, now in record.items():
         if key in ("value", "compiled_peak_mem_bytes") and not same_config:
+            continue
+        if key.endswith("_noise_band_pct"):   # metadata, not a metric
             continue
         if not isinstance(now, (int, float)) or isinstance(now, bool):
             continue
@@ -453,20 +543,108 @@ def _find_regressions(record: dict, prev: dict, check_missing: bool = True):
             continue
         thr = next((t for p, t in _REL_THRESHOLD.items() if p in key),
                    _DEFAULT_REL_THRESHOLD)
+        # VERDICT r5 #2: metrics with a MEASURED noise band (N interleaved
+        # re-runs, *_noise_band_pct published beside them) set their
+        # threshold from the data — the larger of the class threshold and
+        # either round's observed band
+        band = max(float(prev.get(f"{key}_noise_band_pct") or 0.0),
+                   float(record.get(f"{key}_noise_band_pct") or 0.0)) / 100.0
+        thr = max(thr, band)
         change = (now - was) / abs(was)
         if (worse_if_down and change < -thr) or (worse_if_up and change > thr):
             out.append(_regression_entry(key, was, now,
                                          round(change * 100.0, 1),
-                                         f"{thr:.0%}"))
+                                         f"{thr:.0%}",
+                                         band_pct=round(band * 100.0, 1)
+                                         if band else None))
     return out
 
 
-def _regression_entry(key, prev, now, change_pct, threshold):
+def _regression_entry(key, prev, now, change_pct, threshold, band_pct=None):
     entry = {"metric": key, "prev": prev, "now": now,
              "change_pct": change_pct, "threshold": threshold}
+    notes = []
+    if band_pct:
+        notes.append(f"threshold includes the measured interleaved-re-run "
+                     f"noise band ({band_pct}% of median) — the move is "
+                     f"outside it")
     if key in _EXPECTED_MOVES:
-        entry["note"] = _EXPECTED_MOVES[key]
+        notes.append(_EXPECTED_MOVES[key])
+    if notes:
+        entry["note"] = "; ".join(notes)
     return entry
+
+
+def timed_eval(kind: str, bs: int, seq: int, steps: int) -> dict:
+    """Eval throughput through the REAL pad-and-mask eval path (VERDICT
+    r5 #7): make_eval_step's masked reduction with a padded final batch
+    (`valid` carrying zeros exactly as BatchLoader pad_last emits), so a
+    routing change at eval shapes — this round makes several — cannot
+    regress inference invisibly.  Tracked fields:
+    resnet_eval_img_per_sec_bs* and transformer_eval_ex_per_sec_*."""
+    import jax
+    import jax.numpy as jnp
+
+    from faster_distributed_training_tpu.cli import (build_model,
+                                                     enable_compilation_cache)
+    from faster_distributed_training_tpu.config import (TrainConfig,
+                                                        resolve_tricks)
+    from faster_distributed_training_tpu.optim import build_optimizer
+    from faster_distributed_training_tpu.parallel import make_mesh
+    from faster_distributed_training_tpu.parallel.placement import (
+        make_put_batch, shard_train_state)
+    from faster_distributed_training_tpu.train import create_train_state
+    from faster_distributed_training_tpu.train.steps import make_eval_step
+
+    enable_compilation_cache()
+    mesh = make_mesh(("dp",))
+    rr = np.random.default_rng(2)
+    if kind == "transformer":
+        cfg = resolve_tricks(TrainConfig(
+            model="transformer", dataset="agnews", num_classes=4,
+            batch_size=bs, seq_len=seq, optimizer="sgd", precision="bf16",
+            epochs=1, attention=os.environ.get("FDT_BENCH_TF_ATTN", ""),
+            tricks="on"))
+        model = build_model(cfg, vocab_size=30522, mesh=mesh)
+        sample = jnp.zeros((bs, seq), jnp.int32)
+        lens = rr.integers(seq // 2, seq + 1, size=(bs,))
+        batch_np = {
+            "tokens": rr.integers(0, 30522, size=(bs, seq)).astype(np.int32),
+            "token_types": np.zeros((bs, seq), np.int32),
+            "mask": (np.arange(seq)[None, :] < lens[:, None]
+                     ).astype(np.int32),
+            "label": rr.integers(0, 4, size=(bs,)).astype(np.int32),
+        }
+    else:
+        cfg = resolve_tricks(TrainConfig(
+            model="resnet50", batch_size=bs, precision="bf16", epochs=1,
+            tricks="on"))
+        model = build_model(cfg)
+        sample = jnp.zeros((bs, 32, 32, 3), jnp.float32)
+        batch_np = {
+            "image": rr.normal(size=(bs, 32, 32, 3)).astype(np.float32),
+            "label": rr.integers(0, 10, size=(bs,)).astype(np.int32),
+        }
+    # the padded-final-batch contract: valid=0 rows count toward nothing
+    valid = np.ones((bs,), np.float32)
+    valid[-max(bs // 8, 1):] = 0.0
+    batch_np["valid"] = valid
+    tx, _ = build_optimizer(cfg, steps_per_epoch=1)
+    state = create_train_state(model, tx, sample, jax.random.PRNGKey(0),
+                               init_kwargs={"train": True})
+    with mesh:
+        state = shard_train_state(state, mesh, cfg)
+        batch = make_put_batch(mesh)(batch_np)
+        step = jax.jit(make_eval_step(cfg))
+        compiled = step.lower(state, batch).compile()
+        for _ in range(5):
+            m = compiled(state, batch)
+        _fence(m)
+        t0 = time.monotonic()
+        for _ in range(steps):
+            m = compiled(state, batch)
+        _fence(m)
+        return {"bs": bs, "seq": seq, "elapsed": time.monotonic() - t0}
 
 
 def _run_child(mode: str, timeout: int = 1800):
@@ -520,6 +698,30 @@ def main() -> None:
         _, cbs, cseq = child.split("_")
         print(json.dumps(timed_gemm_ceiling(int(cbs), int(cseq))))
         return
+    if child.startswith("route_"):
+        # 2D dense/flash crossover arm: one explicit impl at one cell
+        _, cbs, cseq, impl = child.split("_")
+        os.environ["FDT_BENCH_TF_ATTN"] = impl
+        rsteps = int(os.environ.get("FDT_BENCH_ROUTE_STEPS", "10"))
+        print(json.dumps(timed_transformer(int(cbs), int(cseq), rsteps)))
+        return
+    if child == "eval_tf":
+        print(json.dumps(timed_eval("transformer", 256, 256, tf_steps)))
+        return
+    if child == "eval_resnet":
+        print(json.dumps(timed_eval("resnet", bs, 0, steps)))
+        return
+    if child == "ab_ln_256_256":
+        # tentpole A/B arm: LayerNorm saved-stats VJP OFF (r5 behavior)
+        os.environ["FDT_LN_SAVED_STATS"] = "0"
+        print(json.dumps(timed_transformer(256, 256, tf_steps)))
+        return
+    if child == "ab_flashstats_64_512":
+        # tentpole A/B arm: flash saved-(out,lse) backward OFF (r5
+        # in-kernel-recompute backward)
+        os.environ["FDT_FLASH_SAVE_STATS"] = "0"
+        print(json.dumps(timed_transformer(64, 512, tf_steps)))
+        return
 
     n_chips = max(jax.device_count(), 1)
     elapsed, mem = timed_resnet(True, bs, steps)
@@ -547,6 +749,7 @@ def main() -> None:
     }
     if mem:
         record["compiled_peak_mem_bytes"] = int(mem)
+    record["bench_unix_time"] = round(time.time(), 1)
 
     if os.environ.get("FDT_BENCH_FAST") != "1":
         # VERDICT r4 #2a: the % alone is ambiguous across rounds
@@ -568,14 +771,46 @@ def main() -> None:
         # plus XLA's own cost analysis and the compiled peak memory.
         # tfr_256_512 is the remat capacity point (VERDICT r2 #2): the
         # same config with layer checkpointing, showing the memory delta.
+        # VERDICT r5 #2: the four flagged bs64/seq512 + tricks-transformer
+        # moves get resolved by MEASUREMENT, not prose — N interleaved
+        # re-runs of both arms on the same chip (alternating children so
+        # drift decorrelates), median published as the tracked value, the
+        # observed range published beside it as *_noise_band_pct, and the
+        # guard threshold for these metrics derived from that band
+        # (_find_regressions).  FDT_BENCH_REPEATS overrides N.
+        def _median_run(runs):
+            runs = sorted(runs, key=lambda r: r["elapsed"])
+            return runs[len(runs) // 2]
+
+        def _band_pct(runs):
+            es = sorted(r["elapsed"] for r in runs)
+            med = es[len(es) // 2]
+            if len(es) < 2 or not med:
+                return 0.0
+            return round((es[-1] - es[0]) / med * 100.0, 1)
+
+        reps = max(1, int(os.environ.get("FDT_BENCH_REPEATS", "5")))
+        tf64_runs, tricks_tf_runs = [], []
+        for _ in range(reps):
+            r = _run_child("tf_64_512")
+            if r:
+                tf64_runs.append(r)
+            t = _run_child("tricks_tf")
+            if t:
+                tricks_tf_runs.append(t)
+
         tf64_elapsed = None
         for tag, cbs, cseq in (("tf", 256, 256), ("tf", 64, 512),
                                ("tf", 256, 512), ("tfr", 256, 512)):
-            res = _run_child(f"{tag}_{cbs}_{cseq}")
-            if not res:
-                continue
             if (tag, cbs, cseq) == ("tf", 64, 512):
+                if not tf64_runs:
+                    continue
+                res = _median_run(tf64_runs)
                 tf64_elapsed = res["elapsed"]
+            else:
+                res = _run_child(f"{tag}_{cbs}_{cseq}")
+                if not res:
+                    continue
             name = f"bs{cbs}_seq{cseq}" + ("_remat" if tag == "tfr" else "")
             exs = cbs * tf_steps / res["elapsed"] / n_chips
             if tag == "tf" and (cbs, cseq) in ((256, 256), (64, 512)):
@@ -585,6 +820,7 @@ def main() -> None:
                 record[f"transformer_ex_per_sec_{name}"] = round(exs, 1)
             mf = transformer_model_flops(cbs, cseq)
             step_s = res["elapsed"] / tf_steps
+            record[f"transformer_{name}_step_ms"] = round(step_s * 1e3, 2)
             # per-chip: the step is sharded over all visible chips, so
             # achieved TFLOP/s and MFU are divided by the chip count to
             # compare against ONE chip's peak
@@ -603,6 +839,13 @@ def main() -> None:
                     res["xla_bytes_accessed_per_step"] / 1e9, 2)
             if "remat_policy" in res:
                 record[f"transformer_{name}_policy"] = res["remat_policy"]
+            if (tag, cbs, cseq) == ("tf", 64, 512) and len(tf64_runs) > 1:
+                band64 = _band_pct(tf64_runs)
+                record["transformer_bs64_seq512_repeats"] = len(tf64_runs)
+                for kk in (f"transformer_agnews_ex_per_sec_{name}",
+                           f"transformer_{name}_achieved_tflops_per_chip",
+                           f"transformer_{name}_mfu_pct"):
+                    record[kk + "_noise_band_pct"] = band64
         # GEMM-chain ceiling (VERDICT r4 #1): the step's matmul shapes as
         # a bare jitted chain — the measured MXU ceiling the step MFU is
         # judged against (see timed_gemm_ceiling).
@@ -626,13 +869,21 @@ def main() -> None:
         if off_r:
             record["tricks_speedup_resnet50"] = round(
                 off_r["elapsed"] / elapsed, 2)
-        off_t = _run_child("tricks_tf")
-        if off_t and tf64_elapsed:
+        if tricks_tf_runs and tf64_elapsed:
+            # both arms already measured N times interleaved above; the
+            # ratio uses the medians, and the published band is the sum
+            # of both arms' observed ranges (conservative)
+            off_med = _median_run(tricks_tf_runs)["elapsed"]
             record["tricks_speedup_transformer"] = round(
-                off_t["elapsed"] / tf64_elapsed, 2)
+                off_med / tf64_elapsed, 2)
             # the headline analog: the reference's time.png measures the
             # transformer workload at maxlen 512, 64 examples per device
             record["tricks_speedup_x"] = record["tricks_speedup_transformer"]
+            if len(tricks_tf_runs) > 1 and len(tf64_runs) > 1:
+                band = round(_band_pct(tricks_tf_runs)
+                             + _band_pct(tf64_runs), 1)
+                record["tricks_speedup_transformer_noise_band_pct"] = band
+                record["tricks_speedup_x_noise_band_pct"] = band
         # VERDICT r4 #2b: two DEFINITIONS circulate — the bench keys above
         # are RAW COMPILED STEP ratios (loader/H2D excluded); the
         # figures/tricks_times.json epoch runs are FULL PIPELINE.  Say so
@@ -655,6 +906,56 @@ def main() -> None:
                         (sum(off) / len(off)) / (sum(on) / len(on)), 2)
         except Exception:
             pass
+        # 2D dense/flash crossover arms (VERDICT r5 #5): both impls at
+        # every cell the routing surface newly serves, as full NGD train
+        # steps — resolve_attention's surface comment cites these fields
+        # per cell (cli._ATTN_ROUTE_SURFACE).  bs1024/seq256's dense arm
+        # is deliberately NOT run: the materialized probs (6.4 GB) exceed
+        # the routing memory budget, which is exactly why that cell
+        # routes flash.  Opt out with FDT_BENCH_ROUTE=0.
+        if os.environ.get("FDT_BENCH_ROUTE", "1") != "0":
+            rsteps = int(os.environ.get("FDT_BENCH_ROUTE_STEPS", "10"))
+            for cbs, cseq, impls in ATTN_ROUTE_BENCH_CELLS:
+                for impl in impls:
+                    res = _run_child(f"route_{cbs}_{cseq}_{impl}")
+                    if res:
+                        record[f"attn_route_bs{cbs}_seq{cseq}_{impl}"
+                               f"_step_ms"] = round(
+                            res["elapsed"] / rsteps * 1e3, 2)
+            record["attn_route_bs1024_seq256_dense_note"] = (
+                "dense arm deliberately not run: 3*4*B*H*L^2 = 6.4 GB of "
+                "materialized probs exceeds the routing memory budget "
+                "(cli._dense_attn_fits, default FDT_DENSE_ATTN_BUDGET_MB="
+                "4096) — the cell routes flash by the headroom bound")
+        # Tentpole attribution arms (VERDICT r5 #3/#4): the same train
+        # program with ONE lever restored to its r5 behavior, so the
+        # committed record carries each change's measured step-time
+        # delta in-record (the per-arm transformer_*_step_ms fields
+        # above are the ON side of each pair):
+        #   ln_autodiff — LayerNorm under default XLA autodiff instead
+        #     of the saved-(mean, rstd) custom_vjp (FDT_LN_SAVED_STATS=0)
+        #     at bs256/seq256, the 13-site LN-cost shape;
+        #   flash_recompute — the r5 in-kernel-recompute flash backward
+        #     instead of the saved-stats pair (FDT_FLASH_SAVE_STATS=0)
+        #     at bs64/seq512, the flash-routed shape.
+        ab = _run_child("ab_ln_256_256")
+        if ab:
+            record["transformer_bs256_seq256_ln_autodiff_step_ms"] = round(
+                ab["elapsed"] / tf_steps * 1e3, 2)
+        ab = _run_child("ab_flashstats_64_512")
+        if ab:
+            record["transformer_bs64_seq512_flash_recompute_step_ms"] = \
+                round(ab["elapsed"] / tf_steps * 1e3, 2)
+        # Eval throughput under the guard (VERDICT r5 #7): the real
+        # pad-and-mask eval step at each workload's headline shape.
+        ev = _run_child("eval_resnet")
+        if ev:
+            record[f"resnet_eval_img_per_sec_bs{bs}"] = round(
+                bs * steps / ev["elapsed"] / n_chips, 1)
+        ev = _run_child("eval_tf")
+        if ev:
+            record["transformer_eval_ex_per_sec_bs256_seq256"] = round(
+                256 * tf_steps / ev["elapsed"] / n_chips, 1)
         # Long-context attention ladder: DEFAULT-ON (VERDICT r3 #4 — the
         # driver runs plain `python bench.py`, so the envelope numbers
         # must land in BENCH_r*.json without hand-running).  Opt out with
@@ -665,8 +966,9 @@ def main() -> None:
                 record.update(ladder)
 
     # Round-over-round regression guard (VERDICT r4 #2c): compare every
-    # tracked numeric metric against the previous BENCH_r*.json and flag
-    # >5% moves in the harmful direction — no more hand-diffing rounds.
+    # tracked numeric metric against the previous round's record and flag
+    # wrong-way moves past each metric's noise threshold — no more
+    # hand-diffing rounds.
     prev, prev_file = _prev_bench_record()
     if prev:
         record["regression_baseline_file"] = prev_file
@@ -674,10 +976,54 @@ def main() -> None:
         # intentional opt-outs (FDT_BENCH_FAST / FDT_BENCH_ATTN=0) must
         # not read as vanished metrics
         full_run = (os.environ.get("FDT_BENCH_FAST") != "1"
-                    and os.environ.get("FDT_BENCH_ATTN", "1") != "0")
+                    and os.environ.get("FDT_BENCH_ATTN", "1") != "0"
+                    and os.environ.get("FDT_BENCH_ROUTE", "1") != "0")
         record["regressions"] = _find_regressions(record, prev,
                                                   check_missing=full_run)
+    # Evidence chain (VERDICT r5 #1): persist the FULL record to a
+    # committed file beside this script — the driver's 2 KB stdout tail
+    # can never orphan a round's numbers again — and print a compact
+    # essentials line LAST so that tail always carries the headline even
+    # as the record grows.  FDT_BENCH_FAST smoke runs must NOT clobber
+    # the committed full record (a near-empty fast record would become
+    # the newest baseline and the guard would silently compare nothing).
+    if os.environ.get("FDT_BENCH_FAST") != "1":
+        try:
+            with open(os.path.join(_bench_dir(), BENCH_LATEST), "w") as fh:
+                json.dump(record, fh, indent=1)
+                fh.write("\n")
+        except OSError as e:
+            print(f"[bench] could not write {BENCH_LATEST}: {e!r}",
+                  file=sys.stderr)
     print(json.dumps(record))
+    print(json.dumps(_essentials(record)))
+
+
+def _essentials(record: dict) -> dict:
+    """<=1.5 KB headline subset printed as the LAST stdout line: the
+    driver's tail capture parses this even when the full record outgrows
+    it; bench_unix_time ties it back to the full BENCH_LATEST.json."""
+    keys = ("metric", "value", "unit", "ngd_overhead_pct",
+            "transformer_agnews_ex_per_sec_bs256_seq256",
+            "transformer_bs256_seq256_mfu_pct",
+            "transformer_agnews_ex_per_sec_bs64_seq512",
+            "transformer_bs64_seq512_mfu_pct",
+            "transformer_bs64_seq512_mfu_pct_noise_band_pct",
+            "transformer_eval_ex_per_sec_bs256_seq256",
+            "tricks_speedup_x", "bench_unix_time",
+            "regression_baseline_file")
+    ess = {"essentials": True, "full_record": BENCH_LATEST}
+    for k in keys:
+        if k in record:
+            ess[k] = record[k]
+    for k in record:
+        if k.startswith("resnet_eval_img_per_sec"):
+            ess[k] = record[k]
+    regs = record.get("regressions")
+    if regs is not None:
+        ess["regressions_count"] = len(regs)
+        ess["regressed_metrics"] = [r["metric"] for r in regs][:8]
+    return ess
 
 
 if __name__ == "__main__":

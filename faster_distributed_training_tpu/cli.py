@@ -248,19 +248,79 @@ def apply_subset(ds, stride: int):
     return _Subset(ds)
 
 
+# The dense path's backward holds ~3 score-shaped fp32 tensors at peak
+# (saved probs residual + ds/dp transients — same accounting as flash's
+# _DENSE_BWD_BUDGET_BYTES, validated by the measured +1.6 GB at
+# bs256/seq256 ≈ 3 x 537 MB).  The routing budget below caps that
+# footprint so auto-routing can never walk a big-batch config into HBM
+# exhaustion: the materialized probs scale with B·L².  Override via
+# FDT_DENSE_ATTN_BUDGET_MB (0 forces flash everywhere).
+_DENSE_ATTN_BUDGET_MB = 4096
+
+# The measured 2D dense/flash crossover surface (VERDICT r5 #5).  Every
+# cell the auto-router serves cites the bench arm that measures it; the
+# r6 arms (attn_route_*) land in BENCH_LATEST.json per round under the
+# regression guard, so a crossover drift shows up as a flagged move.
+_ATTN_ROUTE_SURFACE = (
+    # (bs, seq, routed impl, bench arm carrying the measurement)
+    (256, 256, "dense", "transformer_agnews_ex_per_sec_bs256_seq256"),
+    (512, 128, "dense", "attn_route_bs512_seq128_dense_step_ms"),
+    (1024, 128, "dense", "attn_route_bs1024_seq128_dense_step_ms"),
+    (512, 256, "dense", "attn_route_bs512_seq256_dense_step_ms"),
+    (1024, 256, "flash", "attn_route_bs1024_seq256_flash_step_ms"),
+    (256, 384, "flash", "attn_route_bs256_seq384_flash_step_ms"),
+    (64, 512, "flash", "transformer_agnews_ex_per_sec_bs64_seq512"),
+)
+
+
+def _dense_attn_fits(bs: int, seq: int, n_heads: int) -> bool:
+    """Memory-headroom term of the routing surface: 3 score-shaped fp32
+    tensors at the dense backward's peak must fit the routing budget."""
+    mb = os.environ.get("FDT_DENSE_ATTN_BUDGET_MB")
+    try:
+        budget_mb = int(mb) if mb is not None else _DENSE_ATTN_BUDGET_MB
+    except ValueError:
+        import warnings
+        warnings.warn(f"ignoring malformed FDT_DENSE_ATTN_BUDGET_MB={mb!r} "
+                      f"(want an integer MB count); using the default "
+                      f"{_DENSE_ATTN_BUDGET_MB}", stacklevel=2)
+        budget_mb = _DENSE_ATTN_BUDGET_MB
+    return 3 * 4 * bs * n_heads * seq * seq <= budget_mb << 20
+
+
 def resolve_attention(cfg: TrainConfig, mesh=None) -> str:
     """'' auto-resolves: ring when the mesh has an sp axis of size > 1;
-    on TPU, DENSE at short sequences and flash beyond; dense off-TPU.
+    on TPU, DENSE inside the measured 2D crossover surface and flash
+    beyond; dense off-TPU.  Explicit --attention always wins.
 
-    The short-sequence routing is measured, not assumed (r5, v5e,
-    bs256/seq256 NGD full step): once the dense path's prob dropout went
-    through the stateless hash engine (no threefry mask tensor), dense
-    measures 99.8 ms/step vs the flash kernel's 111.9 — at L=256 the
-    monolithic kernel's per-(b,h)-instance overhead exceeds XLA's batched
-    GEMM+softmax cost, while at L=512 flash wins (58.6 vs 69.6 ms at
-    bs64).  Dense materializes the [B,H,L,L] probs (bs256/seq256: peak
-    7.8 vs 6.2 GB — well inside HBM), so the crossover is routed on
-    seq_len; explicit --attention always wins."""
+    The 2D surface (r5 + r6 bench arms, v5e, NGD full step):
+
+      * seq<=256, bs<=256 — DENSE: 99.8 ms/step dense vs 111.9 flash @
+        bs256/seq256 once dense prob dropout went through the stateless
+        hash engine — at L<=256 the monolithic kernel's per-(b,h)-
+        instance overhead exceeds XLA's batched GEMM+softmax cost
+        (r5 probe; guarded per-round by
+        transformer_agnews_ex_per_sec_bs256_seq256).
+      * seq<=256, bs in {512, 1024} — DENSE while the probs fit: at
+        fixed L the per-example cost of both paths scales ~linearly in
+        B, so the L-crossover carries over; pinned per-round by the
+        attn_route_bs512_seq128 / bs1024_seq128 / bs512_seq256
+        dense-vs-flash step-ms arm pairs in BENCH_LATEST.json.
+      * memory-headroom bound (_dense_attn_fits): dense materializes
+        ~3 fp32 [B,H,L,L] score tensors at the backward peak (measured
+        +1.6 GB at bs256/seq256), so cells past the budget route flash
+        regardless — bs1024/seq256 is 3·4·1024·8·256² = 6.4 GB > the
+        4 GB default budget (flash side measured by
+        attn_route_bs1024_seq256_flash_step_ms; dense deliberately not
+        benched, the bound exists to keep it un-runnable configs away).
+      * seq >= 384 — FLASH: flash wins from L=512 down (58.6 vs 69.6 ms
+        @ bs64/seq512, transformer_agnews_ex_per_sec_bs64_seq512), and
+        the seq=384 arm pair (attn_route_bs256_seq384_*_step_ms) pins
+        the boundary cell between the measured 256 and 512 points.
+
+    The surface is recorded row-by-row in _ATTN_ROUTE_SURFACE (cell ->
+    impl -> measuring arm) and tests/test_substrate.py asserts every
+    routed cell's arm actually exists in bench.py."""
     if cfg.attention:
         return cfg.attention
     if (mesh is not None and "sp" in mesh.axis_names
@@ -269,11 +329,8 @@ def resolve_attention(cfg: TrainConfig, mesh=None) -> str:
     import jax
     if jax.default_backend() != "tpu":
         return "dense"
-    # measured envelope only: the crossover and the +1.6 GB probs cost
-    # were measured at bs<=256/seq<=256 — larger batches scale the
-    # materialized [B,H,L,L] probs linearly in B and are unmeasured, so
-    # they keep flash (explicit --attention dense opts in regardless)
-    return ("dense" if cfg.seq_len <= 256 and cfg.batch_size <= 256
+    return ("dense" if cfg.seq_len <= 256
+            and _dense_attn_fits(cfg.batch_size, cfg.seq_len, cfg.n_heads)
             else "flash")
 
 
@@ -298,6 +355,22 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                 "INTERPRET mode (orders of magnitude slower) — test-only; "
                 "use --mlp_impl fused for real off-TPU runs", stacklevel=2)
         ffn_impl = cfg.ffn_impl
+        if ffn_impl == "pallas":
+            from faster_distributed_training_tpu.ops.fused_ffn import (
+                ffn_kernel_fits_vmem)
+            if not ffn_kernel_fits_vmem(cfg.d_model, cfg.d_ff,
+                                        jnp.dtype(dtype).itemsize):
+                # ADVICE r5 (low): a user-configured large --d_model/
+                # --d_ff would die with an opaque Mosaic scoped-VMEM
+                # compile error; mirror the tp-mesh fallback instead.
+                import warnings
+                warnings.warn(
+                    f"--ffn_impl pallas: weights+hidden for d_model="
+                    f"{cfg.d_model}, d_ff={cfg.d_ff} exceed the kernel's "
+                    f"VMEM budget (ops/fused_ffn.py ffn_kernel_fits_vmem)"
+                    f"; falling back to the flax FFN composition",
+                    stacklevel=2)
+                ffn_impl = "flax"
         if ffn_impl == "pallas":
             # sharded meshes run the kernel per-shard via shard_map over
             # the data axes (fused_ffn_sublayer_sharded) — EXCEPT tp,

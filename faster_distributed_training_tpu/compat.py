@@ -1,0 +1,60 @@
+"""Version-compat shims for JAX APIs that moved between releases.
+
+The repo targets current JAX (``jax.shard_map`` with ``check_vma``),
+but CI/driver containers have been observed on jaxlib 0.4.x where
+shard_map still lives in ``jax.experimental.shard_map`` and the
+replication-checking kwarg is named ``check_rep``.  One chokepoint here
+keeps every consumer (ops/sequence_parallel.py, ops/fused_ffn.py)
+source-identical across both.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """jax.shard_map / jax.experimental.shard_map.shard_map, whichever
+    this jax provides; check_vma maps onto the old check_rep."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
+def xla_accepts_flags(candidate_flags: str, timeout: int = 120) -> bool:
+    """True iff this jaxlib's XLA accepts ``candidate_flags`` as
+    XLA_FLAGS.  XLA hard-ABORTS the process (parse_flags_from_env.cc
+    F-check) on any unknown flag, so support must be probed in a
+    THROWAWAY subprocess: older jaxlibs (observed: 0.4.37) predate e.g.
+    the CPU collective-timeout flags, and passing them unconditionally
+    turns the caller into a hard abort at first backend use.  Shared by
+    tests/conftest.py and __graft_entry__.dryrun_multichip so the two
+    gates can never drift.  Any probe failure (incl. timeout on a cold
+    import cache) degrades to False — callers keep their un-augmented
+    flags rather than risking the abort."""
+    import os
+    import subprocess
+    import sys
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env={**os.environ, "XLA_FLAGS": candidate_flags,
+                 "JAX_PLATFORMS": "cpu"},
+            capture_output=True, timeout=timeout)
+    except Exception:
+        return False
+    return r.returncode == 0
+
+
+def axis_size(axis_name) -> int:
+    """lax.axis_size (new jax) as a STATIC int — consumers use it for
+    Python-level loop/scan lengths.  On 0.4.x it predates lax, but the
+    tracing axis env knows the bound size."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    from jax._src import core as _core
+    return _core.get_axis_env().axis_size(axis_name)

@@ -70,16 +70,21 @@ class TorchLayerNorm(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         from faster_distributed_training_tpu.ops.layernorm import (
-            torch_layernorm_f32)
+            torch_layernorm)
 
         d = x.shape[-1]
         a = self.param("scale", nn.initializers.ones, (d,), self.param_dtype)
         b = self.param("bias", nn.initializers.zeros, (d,), self.param_dtype)
         # fp32 core shared with the fused FFN kernel (ops/layernorm.py):
-        # unbiased std (torch x.std default), eps added to std not var
-        y = torch_layernorm_f32(x.astype(jnp.float32),
-                                a.astype(jnp.float32),
-                                b.astype(jnp.float32), self.eps)
+        # unbiased std (torch x.std default), eps added to std not var.
+        # torch_layernorm is the saved-(mean, rstd) custom_vjp form — the
+        # backward rebuilds x-hat from the input instead of storing the
+        # centered/normalized intermediates (the r5-measured ~7.5 ms of
+        # LN HBM round-trips across the 13 sites; FDT_LN_SAVED_STATS=0
+        # restores default autodiff for probes).
+        y = torch_layernorm(x.astype(jnp.float32),
+                            a.astype(jnp.float32),
+                            b.astype(jnp.float32), self.eps)
         return y.astype(self.dtype)
 
 
@@ -173,6 +178,11 @@ class MultiheadAttention(nn.Module):
                                       # ablation's unfused arm (different
                                       # param layout, ablation-only)
     dropout_impl: str = "hash"        # prob-dropout engine for dense
+    flash_save_stats: bool = True     # False inside rematted regions:
+                                      # out/lse residuals would force the
+                                      # flash forward to re-run in the
+                                      # remat replay (flash_attention
+                                      # docstring)
 
     @nn.compact
     def __call__(self, x: jax.Array, mask: Optional[jax.Array],
@@ -210,9 +220,14 @@ class MultiheadAttention(nn.Module):
         if self.attention_impl == "flash":
             from faster_distributed_training_tpu.ops.flash_attention import (
                 flash_attention)
+            # flash_save_stats=True defers to the FDT_FLASH_SAVE_STATS
+            # env default (None) so the A/B kill switch still works;
+            # False (rematted attention) is a hard override
             ctx = flash_attention(q, k, v, mask=mask,
                                   dropout_rate=drop_rate,
-                                  dropout_seed=drop_seed)
+                                  dropout_seed=drop_seed,
+                                  save_stats=(None if self.flash_save_stats
+                                              else False))
         elif self.attention_impl in ("ring", "ulysses"):
             if self.mesh is None:
                 raise ValueError(
@@ -341,6 +356,7 @@ class EncoderLayer(nn.Module):
     remat_ffn: bool = False   # checkpoint the FFN sublayer only ("ffn")
     fused_qkv: bool = True
     ffn_impl: str = "flax"    # flax | pallas (ops/fused_ffn.py mega-kernel)
+    flash_save_stats: bool = True   # False under attention-wrapping remat
 
     @nn.compact
     def __call__(self, h: jax.Array, mask: Optional[jax.Array],
@@ -353,11 +369,22 @@ class EncoderLayer(nn.Module):
                                self.attention_impl, self.mesh,
                                self.sp_axis, self.fused_qkv,
                                dropout_impl=self.dropout_impl,
+                               flash_save_stats=self.flash_save_stats,
                                name="attn")(a, mask, train)
         a = FastDropout(self.dropout_connection_attention,
                         self.dropout_impl)(a, deterministic=not train)
         h = h + a
-        if self.ffn_impl == "pallas":
+        # ADVICE r5 (medium): the kernel's in-VMEM dropout IS the hash
+        # engine — it must follow dropout_impl like every other site.
+        # "none" (the all-dropout-off floor switch) runs the kernel with
+        # rates 0; "xla" (the --tricks off reference-naive arm) needs the
+        # threefry nn.Dropout masks, which only the Flax composition can
+        # apply, so active-dropout + non-hash engines fall back to it.
+        ffn_dropout_active = (train and self.dropout_impl != "none"
+                              and (self.dropout_ffn > 0
+                                   or self.dropout_connection_ffn > 0))
+        if self.ffn_impl == "pallas" and (not ffn_dropout_active
+                                          or self.dropout_impl == "hash"):
             # fused sublayer (ops/fused_ffn.py): LN + FFN + both dropout
             # sites + residual in one Pallas kernel, recompute backward —
             # zero FFN-shaped residuals (a capacity lever; see PARITY for
@@ -377,9 +404,7 @@ class EncoderLayer(nn.Module):
             w1, b1, w2, b2 = _FFNParamMirror(
                 self.d_model, self.d_ff, self.dtype, self.param_dtype,
                 name="ffn")(h[..., :1, :])
-            training = train and (self.dropout_ffn > 0
-                                  or self.dropout_connection_ffn > 0)
-            if training:
+            if ffn_dropout_active:
                 seeds = jax.random.bits(self.make_rng("dropout"), (2,),
                                         dtype=jnp.uint32)
                 hid_seed, out_seed = seeds[0], seeds[1]
@@ -488,6 +513,13 @@ class Transformer(nn.Module):
                     .dots_with_no_batch_dims_saveable)
             else:   # "layer" (round-3 behavior)
                 layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
+        # remat policies that wrap ATTENTION ("layer"/"attn_out"/"dots")
+        # recompute custom_vjp residuals in the backward replay: flash
+        # must keep its residuals input-only there, or the saved
+        # (out, lse) would force the forward kernel to re-run in the
+        # replay (flash_attention docstring).  "ffn" checkpoints only
+        # the FFN sublayer, so attention keeps the saved-stats backward.
+        flash_save_stats = not (self.remat and self.remat_policy != "ffn")
         for i in range(self.n_layers):
             h = layer_cls(self.h, self.d_model, self.d_ff,
                           self.dropout_connection_attention,
@@ -496,7 +528,7 @@ class Transformer(nn.Module):
                           self.dtype, self.param_dtype,
                           self.attention_impl, self.mesh, self.sp_axis,
                           self.dropout_impl, remat_ffn, self.fused_qkv,
-                          self.ffn_impl,
+                          self.ffn_impl, flash_save_stats,
                           name=f"layer_{i}")(h, mask, train)
 
         ln = lambda name: TorchLayerNorm(   # noqa: E731

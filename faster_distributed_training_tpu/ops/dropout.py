@@ -84,7 +84,17 @@ def keep_factor_rows(seed: jax.Array, global_rows: jax.Array, cols: int,
     sharded callers (ops/fused_ffn.py under shard_map) address the
     GLOBAL index space even when their local rows are not globally
     contiguous (sequence-sharded layouts) — masks depend only on
-    (seed, global position), never on device placement."""
+    (seed, global position), never on device placement.
+
+    CEILING (ADVICE r5 low): the element index ``global_row*cols + c``
+    mixes in uint32, so the placement-invariance contract holds only for
+    global activation tensors up to 2^32 elements (~4.3 G elements; at
+    d_ff=1024 that is a global batch*seq of ~4.2 M rows).  Past it the
+    index wraps and distant positions silently share mask bits —
+    statistically harmless (the wrapped stream is still uniform) but no
+    longer a unique per-element draw.  If larger global tensors come
+    into scope, widen the mixing to 64 bits (two fmix rounds over row
+    and column) rather than relying on the wrap."""
     t = _thresh_u16(rate)
     rows = int(np.shape(global_rows)[0])
     if t <= 0:   # rate within half a grid step of 1: drop everything

@@ -52,7 +52,8 @@ import numpy as np
 from jax import lax
 
 from faster_distributed_training_tpu.ops.dropout import keep_factor_rows
-from faster_distributed_training_tpu.ops.layernorm import torch_layernorm_f32
+from faster_distributed_training_tpu.ops.layernorm import (torch_layernorm,
+                                                           torch_layernorm_f32)
 
 try:
     from jax.experimental import pallas as pl
@@ -85,8 +86,14 @@ def _gelu_f32(h1: jax.Array) -> jax.Array:
 
 
 # TorchLayerNorm's fp32 core — ONE definition shared with the Flax
-# module (ops/layernorm.py), so kernel and model can't desynchronize
+# module (ops/layernorm.py), so kernel and model can't desynchronize.
+# The Pallas kernel traces the PURE primal (Mosaic never differentiates
+# it); the XLA reference fn — which the custom_vjp backward jax.vjp's —
+# uses the saved-stats form so the recompute backward's inner LN also
+# saves (mean, rstd) instead of re-deriving the rsqrt chain.  Both share
+# one forward definition, so kernel-vs-reference outputs stay identical.
 _ln_f32 = torch_layernorm_f32
+_ln_saved = torch_layernorm
 
 
 # the mask stream lives in ops/dropout.py (one source of truth); this
@@ -126,8 +133,8 @@ def ffn_sublayer_reference(h: jax.Array, ln_scale: jax.Array,
     x32 = h.reshape(-1, d).astype(jnp.float32)
     n_rows = x32.shape[0]
     grows = _global_rows(lax.iota(jnp.uint32, n_rows), b0, s0, l_loc, l_glob)
-    f = _ln_f32(x32, ln_scale.astype(jnp.float32),
-                ln_bias.astype(jnp.float32), eps).astype(h.dtype)
+    f = _ln_saved(x32, ln_scale.astype(jnp.float32),
+                  ln_bias.astype(jnp.float32), eps).astype(h.dtype)
     h1 = jnp.dot(f, w1, preferred_element_type=jnp.float32) \
         + b1.astype(jnp.float32)
     a = _gelu_f32(h1)
@@ -172,12 +179,56 @@ def _ffn_kernel(h_ref, lns_ref, lnb_ref, w1_ref, b1_ref, w2_ref, b2_ref,
     o_ref[...] = (x32 + f2).astype(o_ref.dtype)
 
 
+# Static VMEM budget for the kernel's resident set (ADVICE r5 low): both
+# weight matrices + the fp32 hidden/row tiles must fit scoped VMEM or
+# Mosaic dies with an opaque compile error at large --d_model/--d_ff.
+# 12 MiB of the ~16 MiB budget leaves margin for Pallas double-buffering
+# of the in/out row blocks; the default 512/1024 config sits at ~5.6 MiB.
+_FFN_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _ffn_vmem_bytes(d: int, d_ff: int, w_bytes: int,
+                    block_rows: int) -> int:
+    """Resident-set model: w1+w2 at their dtype, fp32 hidden pair
+    (pre-GELU + activation), and the x32/LN/out fp32 row tiles."""
+    return (2 * d * d_ff * w_bytes
+            + 2 * block_rows * d_ff * 4
+            + 3 * block_rows * d * 4)
+
+
+def ffn_kernel_fits_vmem(d: int, d_ff: int, w_bytes: int = 2) -> bool:
+    """True iff the kernel fits the VMEM budget at its SMALLEST row tile
+    — the static go/no-go check build_model mirrors (falling back to the
+    flax composition, like the tp-mesh fallback) before handing the
+    model a kernel that cannot compile."""
+    return _ffn_vmem_bytes(d, d_ff, w_bytes, 32) <= _FFN_VMEM_BUDGET
+
+
 def _ffn_fwd_pallas(h2d, ln_scale, ln_bias, w1, b1, w2, b2, seeds,
                     rate_hidden, rate_conn, eps, l_loc, l_glob,
                     block_rows=256):
     B, d = h2d.shape
     d_ff = w1.shape[1]
+    w_bytes = jnp.dtype(w1.dtype).itemsize
     block_rows = min(block_rows, B)
+    # degrade the row tile before giving up: the hidden tiles scale with
+    # block_rows, so halving buys headroom down to the 32-row floor
+    while (block_rows > 32
+           and _ffn_vmem_bytes(d, d_ff, w_bytes,
+                               block_rows) > _FFN_VMEM_BUDGET):
+        block_rows //= 2
+    if _ffn_vmem_bytes(d, d_ff, w_bytes, block_rows) > _FFN_VMEM_BUDGET:
+        import warnings
+        warnings.warn(
+            f"fused FFN kernel resident set for d_model={d}, d_ff={d_ff} "
+            f"exceeds the ~{_FFN_VMEM_BUDGET >> 20} MiB VMEM budget even "
+            f"at the minimum row tile; computing this sublayer with the "
+            f"XLA reference path instead (same math, default autodiff)",
+            stacklevel=2)
+        return ffn_sublayer_reference(
+            h2d, ln_scale, ln_bias, w1, b1, w2, b2, seeds[0, 0],
+            seeds[0, 1], rate_hidden, rate_conn, eps, seeds[0, 2],
+            seeds[0, 3], l_loc, l_glob)
     nb = -(-B // block_rows)
     pad = nb * block_rows - B
     if pad:
@@ -280,9 +331,12 @@ def fused_ffn_sublayer_sharded(h, ln_scale, ln_bias, w1, b1, w2, b2,
     placement-invariance convention as every other sharded dropout
     consumer (ops/attention.py dropout_keep): masks depend only on
     (seed, global position), so the SAME global batch draws the SAME
-    masks on dp=1, dp=4 or dp=8, bit-for-bit.  tp-sharded FFN weights
-    remain unsupported (build_model falls back — gathering
-    tensor-parallel weights per step would defeat tp)."""
+    masks on dp=1, dp=4 or dp=8, bit-for-bit.  The global index space is
+    uint32 — the contract holds up to 2^32 elements per activation
+    tensor (see ops.dropout.keep_factor_rows for the documented wrap
+    behavior past it).  tp-sharded FFN weights remain unsupported
+    (build_model falls back — gathering tensor-parallel weights per
+    step would defeat tp)."""
     from jax.sharding import PartitionSpec as P
 
     batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names
@@ -314,7 +368,8 @@ def fused_ffn_sublayer_sharded(h, ln_scale, ln_bias, w1, b1, w2, b2,
                          b0, s0, rate_hidden, rate_conn, eps,
                          l_loc, l_loc * sp_size)
 
-    return jax.shard_map(
+    from faster_distributed_training_tpu.compat import shard_map
+    return shard_map(
         per_shard, mesh=mesh,
         in_specs=(data_spec, rep, rep, rep, rep, rep, rep, P(), P()),
         out_specs=data_spec,

@@ -126,10 +126,23 @@ class Trainer:
                     state.batch_stats,
                     self._offload_shardings.batch_stats))
         acc = MetricAccumulator()
+        t0 = time.monotonic()
         for batch in device_prefetch(loader, self.put_eval_batch,
                                      depth=self.cfg.prefetch_depth):
             acc.add(self.eval_step(state, batch))
-        return acc.summary()
+        summary = acc.summary()   # device->host sync fences the timing
+        elapsed = time.monotonic() - t0
+        # eval throughput made visible per epoch (VERDICT r5 #7): the
+        # routing changes this repo makes at eval shapes must not be
+        # able to regress inference silently — bench.py tracks the
+        # compiled eval step (resnet_eval_img_per_sec_* /
+        # transformer_eval_ex_per_sec_*) under the regression guard,
+        # and this line surfaces the full-pipeline number per run.
+        total = summary.get("total_sum")
+        if total:
+            self.log(f"[eval] {total:.0f} samples in {elapsed:.1f}s "
+                     f"({total / max(elapsed, 1e-9):.0f} ex/s)")
+        return summary
 
     def fit(self, state: TrainState, train_loader: LoaderFn,
             eval_loader: LoaderFn, ckpt_name: str = "ckpt",

@@ -26,6 +26,8 @@ VARIANTS = {
     # name: (bs, seq, opt, remat[, attention, mlp_impl, dropout_impl,
     #        mode]) — mode: "" | "noln" (identity LayerNorm probe)
     #        | "ffn_pallas" (fused FFN-sublayer kernel arm)
+    #        | "ln_autodiff" (saved-stats LN VJP disabled, r6)
+    #        | "flash_recompute" (flash saved-stats backward disabled, r6)
     "ngd_256_256": (256, 256, "ngd", False),
     "sgd_256_256": (256, 256, "sgd", False),
     "adamw_256_256": (256, 256, "adamw", False),
@@ -52,6 +54,21 @@ VARIANTS = {
     # ~7.5 ms = ~6.7% of the step (pure HBM round-trips: 13 sites x
     # read+write in fwd and bwd ~ 4-5 GB/step at ~800 GB/s).
     "ngd_256_256_noln": (256, 256, "ngd", False, "", "", "hash", "noln"),
+    # Saved-stats LN VJP attribution (r6, ops/layernorm.py): the same
+    # step with the custom_vjp disabled (default XLA autodiff at all 13
+    # LN sites) — baseline-vs-this is the measured recovery of the ~7.5
+    # ms the noln probe attributed; the remaining noln delta is the LN
+    # forward's irreducible cost.  bench.py tracks the same pair as
+    # transformer_bs256_seq256_step_ms vs _ln_autodiff_step_ms.
+    "ngd_256_256_ln_autodiff": (256, 256, "ngd", False, "", "", "hash",
+                                "ln_autodiff"),
+    # Flash saved-(out,lse) backward attribution (r6,
+    # ops/flash_attention.py) at the flash-routed shape: the same step
+    # with FDT_FLASH_SAVE_STATS=0 (r5 in-kernel-recompute backward);
+    # bench.py tracks the pair as transformer_bs64_seq512_step_ms vs
+    # _flash_recompute_step_ms.
+    "ngd_64_512_flash_recompute": (64, 512, "ngd", False, "flash", "",
+                                   "hash", "flash_recompute"),
     # Fused FFN-sublayer kernel (r5, ops/fused_ffn.py): the capacity-
     # lever arm beside the flax default — measured 244 ms @ 10.7 GB vs
     # flax 225 @ 12.0 at bs256/seq512 (PARITY).
@@ -81,6 +98,10 @@ def run_variant(name: str) -> dict:
         T.TorchLayerNorm.__call__ = _ident_ln
     elif mode == "ffn_pallas":
         os.environ["FDT_BENCH_TF_FFN"] = "pallas"
+    elif mode == "ln_autodiff":
+        os.environ["FDT_LN_SAVED_STATS"] = "0"
+    elif mode == "flash_recompute":
+        os.environ["FDT_FLASH_SAVE_STATS"] = "0"
     import bench
     res = bench.timed_transformer(bs, seq, steps=20, remat=remat)
     res["variant"] = name

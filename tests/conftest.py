@@ -8,14 +8,32 @@ os.environ["JAX_PLATFORMS"] = os.environ.get("FDT_TEST_PLATFORM", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = flags + " --xla_force_host_platform_device_count=8"
+
+
+# one shared subprocess probe (compat.xla_accepts_flags): XLA hard-aborts
+# on unknown flags, and older jaxlibs predate the collective-timeout
+# flags below — probing keeps the suite alive on both generations.
+# (compat imports jax, which is fine before the flags settle: XLA parses
+# XLA_FLAGS at first backend use, not at import — the same reason the
+# sitecustomize pre-import is tolerated below.)
+import sys as _sys  # noqa: E402
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from faster_distributed_training_tpu.compat import (  # noqa: E402
+    xla_accepts_flags as _xla_accepts)
+
 if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
     # 8 virtual device threads can share ONE physical core here; XLA's CPU
     # collective rendezvous aborts the process if a participant is >40s late
     # (rendezvous.cc), which a starved thread legitimately can be.  Raise the
-    # warn/terminate timeouts so slow scheduling is slow, not fatal.
-    flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
-              " --xla_cpu_collective_call_terminate_timeout_seconds=1800"
-              " --xla_cpu_collective_timeout_seconds=1800")
+    # warn/terminate timeouts so slow scheduling is slow, not fatal —
+    # on jaxlibs new enough to know the flags (probed above).
+    candidate = flags + (
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=1800"
+        " --xla_cpu_collective_timeout_seconds=1800")
+    if _xla_accepts(candidate.strip()):
+        flags = candidate
 os.environ["XLA_FLAGS"] = flags.strip()
 
 # AVX2 cap (x86 only): AVX-512 targeting bakes +prefer-no-* pseudo-features

@@ -540,6 +540,62 @@ class TestPallasBackwardKernel:
                                monkeypatch=monkeypatch),
             self._grads_ref(q, k, v, rate=0.3, seed=seed))
 
+    def test_saved_stats_and_recompute_backwards_agree(self, monkeypatch):
+        """r6 saved-(out, lse) monolithic backward (the L=512 retune)
+        vs the r5 in-kernel-recompute kernel (FDT_FLASH_SAVE_STATS=0):
+        both must match the dense reference — with a padding mask,
+        ragged q (pad rows) AND dropout, the full hard-mode combo."""
+        q, k, v = _qkv(jax.random.PRNGKey(74), B=2, H=2, L=12, D=8)
+        mask = _padding_mask(jax.random.PRNGKey(75), B=2,
+                             L=12)[:, None, None, :]
+        seed = jnp.uint32(123)
+        assert os.environ.get("FDT_FLASH_SAVE_STATS") is None
+        g_stats = self._grads_kernel(q, k, v, mask, rate=0.3, seed=seed,
+                                     monkeypatch=monkeypatch)
+        monkeypatch.setenv("FDT_FLASH_SAVE_STATS", "0")
+        g_rec = self._grads_kernel(q, k, v, mask, rate=0.3, seed=seed,
+                                   monkeypatch=monkeypatch)
+        monkeypatch.delenv("FDT_FLASH_SAVE_STATS")
+        g_ref = self._grads_ref(q, k, v, mask, rate=0.3, seed=seed)
+        self._check(g_stats, g_ref)
+        self._check(g_rec, g_ref)
+        for name, a, b in zip("qkv", g_stats, g_rec):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=f"stats-vs-recompute d{name}")
+
+    def test_forward_emits_exact_lse(self, monkeypatch):
+        """The emit_lse forward's row lse must equal the dense
+        log-sum-exp of the biased scores (it becomes a residual the
+        backward trusts verbatim)."""
+        import importlib
+        import math as _math
+        fa = importlib.import_module(
+            "faster_distributed_training_tpu.ops.flash_attention")
+        os.environ["FDT_FORCE_PALLAS_INTERPRET"] = "1"
+        try:
+            B, H, L, D = 2, 2, 12, 8
+            q, k, v = _qkv(jax.random.PRNGKey(76), B=B, H=H, L=L, D=D)
+            mask2d = _padding_mask(jax.random.PRNGKey(77), B=B, L=L)
+            from faster_distributed_training_tpu.ops.attention import (
+                mask_to_bias)
+            key_bias = mask_to_bias(mask2d)
+            n3 = lambda x: x.reshape(B * H, L, D)  # noqa: E731
+            out, lse = fa._flash_fwd_pallas(
+                n3(q), n3(k), n3(v), key_bias, H, block_q=8, emit_lse=True)
+            s = (jnp.einsum("bhqd,bhkd->bhqk", q, k) / _math.sqrt(D)
+                 + key_bias[:, None, None, :])
+            lse_ref = jax.nn.logsumexp(s, axis=-1).reshape(B * H, L)
+            np.testing.assert_allclose(np.asarray(lse),
+                                       np.asarray(lse_ref),
+                                       rtol=1e-5, atol=1e-5)
+            ref = fa.flash_attention(q, k, v, mask=mask2d, block_q=8)
+            np.testing.assert_allclose(
+                np.asarray(out.reshape(B, H, L, D)), np.asarray(ref),
+                rtol=1e-5, atol=1e-5)
+        finally:
+            del os.environ["FDT_FORCE_PALLAS_INTERPRET"]
+
 
 class TestKernelEnvelopeRouting:
     """Beyond the monolithic Pallas kernels' empirical VMEM caps the

@@ -22,9 +22,17 @@ _WORKER = r"""
 import os, sys, json
 import numpy as np
 os.environ["JAX_PLATFORMS"] = "cpu"
+# 4 virtual devices per process: the env flag works on every jaxlib and
+# must be set BEFORE importing jax; jax_num_cpu_devices is the newer
+# config spelling (absent on 0.4.x), applied when available.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:
+    pass
 sys.path.insert(0, {repo!r})
 from faster_distributed_training_tpu.parallel import (initialize_distributed,
                                                       make_mesh)
@@ -96,6 +104,15 @@ def test_two_process_world(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    if any("Multiprocess computations aren't implemented on the CPU "
+           "backend" in out for out in outs):
+        # jaxlib 0.4.x: the CPU backend predates cross-process
+        # collectives entirely — the capability this test exercises does
+        # not exist on this jax version, independent of our code.  Newer
+        # jaxlibs run the real 2-process world below.
+        import pytest
+        pytest.skip("this jaxlib's CPU backend has no multiprocess "
+                    "collectives (added in later jax releases)")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out}"
         assert '"ok": true' in out, out

@@ -417,3 +417,103 @@ class TestFusedFFNSublayer:
         # FFN weights actually receive gradient through the kernel path
         gffn = g["layer_0"]["ffn"]["Dense_0"]["kernel"]
         assert float(jnp.max(jnp.abs(gffn))) > 0.0
+
+
+class TestSavedStatsLayerNorm:
+    """ops/layernorm.py torch_layernorm (VERDICT r5 #4): the saved-
+    (mean, rstd) custom_vjp must be forward-BIT-IDENTICAL to the pure
+    fp32 math at the reference's NONSTANDARD semantics (UNBIASED n-1
+    variance, eps added to the STD, not the variance) and gradient-equal
+    to XLA autodiff of that math — the 13 LN sites all route through it,
+    so a backward-math slip would corrupt every transformer gradient."""
+
+    def _xsb(self, key, shape=(3, 5, 16), dtype=jnp.float32):
+        ks = jax.random.split(key, 3)
+        return (jax.random.normal(ks[0], shape, dtype),
+                jax.random.normal(ks[1], shape[-1:], dtype),
+                jax.random.normal(ks[2], shape[-1:], dtype))
+
+    def test_forward_bit_identical_and_unbiased_semantics(self):
+        from faster_distributed_training_tpu.ops.layernorm import (
+            _ln_saved_stats, torch_layernorm, torch_layernorm_f32)
+        x, s, b = self._xsb(jax.random.PRNGKey(0))
+        eps = 1e-6
+        got = torch_layernorm(x, s, b, eps)
+        pure = torch_layernorm_f32(x, s, b, eps)
+        assert np.array_equal(np.asarray(got), np.asarray(pure))
+        assert np.array_equal(np.asarray(_ln_saved_stats(x, s, b, eps)),
+                              np.asarray(pure))
+        # explicit reference of the nonstandard semantics
+        xn = np.asarray(x, np.float64)
+        mean = xn.mean(-1, keepdims=True)
+        var = ((xn - mean) ** 2).sum(-1, keepdims=True) / (xn.shape[-1] - 1)
+        ref = (np.asarray(s, np.float64) * (xn - mean)
+               / (np.sqrt(var) + eps) + np.asarray(b, np.float64))
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-5,
+                                   atol=2e-6)
+
+    @pytest.mark.parametrize("dtype,rtol", [(jnp.float32, 2e-5),
+                                            (jnp.float64, 1e-10)])
+    def test_backward_matches_autodiff(self, dtype, rtol):
+        from faster_distributed_training_tpu.ops.layernorm import (
+            _ln_saved_stats, torch_layernorm_f32)
+        x, s, b = self._xsb(jax.random.PRNGKey(1), dtype=dtype)
+        eps = 1e-6
+
+        def loss_vjp(x_, s_, b_):
+            return jnp.sum(jnp.sin(_ln_saved_stats(x_, s_, b_, eps)))
+
+        def loss_ref(x_, s_, b_):
+            return jnp.sum(jnp.sin(torch_layernorm_f32(x_, s_, b_, eps)))
+
+        g_vjp = jax.grad(loss_vjp, argnums=(0, 1, 2))(x, s, b)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(x, s, b)
+        for name, a, c in zip(("x", "scale", "bias"), g_vjp, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=rtol, atol=rtol,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_residuals_are_input_plus_two_scalars_per_row(self):
+        # the point of the VJP: residual tensors are x, scale, and ONE
+        # (mean, rstd) scalar pair per row — nothing normalized-shaped
+        from faster_distributed_training_tpu.ops.layernorm import _ln_fwd
+        x, s, b = self._xsb(jax.random.PRNGKey(2))
+        out, res = _ln_fwd(x, s, b, 1e-6)
+        x_r, s_r, mean, rstd = res
+        assert x_r.shape == x.shape and s_r.shape == s.shape
+        assert mean.shape == x.shape[:-1] + (1,)
+        assert rstd.shape == x.shape[:-1] + (1,)
+
+    def test_kill_switch_restores_default_autodiff(self, monkeypatch):
+        from faster_distributed_training_tpu.ops import layernorm as ln
+        x, s, b = self._xsb(jax.random.PRNGKey(3))
+        monkeypatch.setenv("FDT_LN_SAVED_STATS", "0")
+        off = ln.torch_layernorm(x, s, b, 1e-6)
+        monkeypatch.delenv("FDT_LN_SAVED_STATS")
+        on = ln.torch_layernorm(x, s, b, 1e-6)
+        assert np.array_equal(np.asarray(off), np.asarray(on))
+
+    def test_transformer_layernorm_module_routes_through_vjp(self):
+        # TorchLayerNorm (models/transformer.py) delegates here; its
+        # grads must equal the pure-math autodiff at model shapes
+        from faster_distributed_training_tpu.models.transformer import (
+            TorchLayerNorm)
+        from faster_distributed_training_tpu.ops.layernorm import (
+            torch_layernorm_f32)
+        m = TorchLayerNorm()
+        x = jax.random.normal(jax.random.PRNGKey(4), (2, 6, 32),
+                              jnp.float32)
+        v = m.init(jax.random.PRNGKey(5), x)
+
+        def loss(p, x_):
+            return jnp.sum(m.apply(p, x_) ** 2)
+
+        gx = jax.grad(loss, argnums=1)(v, x)
+
+        def loss_ref(x_):
+            return jnp.sum(torch_layernorm_f32(
+                x_, v["params"]["scale"], v["params"]["bias"], m.eps) ** 2)
+
+        gx_ref = jax.grad(loss_ref)(x)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                                   rtol=2e-5, atol=2e-6)

@@ -81,10 +81,30 @@ def test_bench_regression_guard():
                                     "untracked_thing": 3.0})
     assert gone == [{"metric": "attn_fwdbwd_ms_L2048", "prev": 8.0,
                      "now": None, "missing": True}]
-    # and the repo's real previous record parses, unwrapping the
-    # driver's {n, cmd, rc, tail, parsed} envelope to the record itself
+    # VERDICT r5 #2: a published measured noise band raises the metric's
+    # threshold — a move inside the band is NOT flagged, outside IS, and
+    # the band itself is metadata, never a compared metric
+    key = "transformer_agnews_ex_per_sec_bs64_seq512"
+    inside = bench._find_regressions(
+        {key: 1030.0, f"{key}_noise_band_pct": 7.0}, {key: 1098.0})
+    assert inside == []
+    outside = bench._find_regressions(
+        {key: 950.0, f"{key}_noise_band_pct": 7.0}, {key: 1098.0})
+    assert [r["metric"] for r in outside] == [key]
+    assert "noise band" in outside[0]["note"]
+    assert not bench._find_regressions(
+        {"value": 100.0}, {"value": 100.0, f"{key}_noise_band_pct": 7.0})
+    # VERDICT r5 #1: the repo's real previous record parses — driver
+    # wrappers whose `parsed` is null and whose tail is a truncated
+    # mid-record fragment (BENCH_r05.json) are SKIPPED, never returned,
+    # and the committed BENCH_LATEST.json full record backstops them
+    import os as _os2
+    assert bench._load_bench_record(
+        _os2.path.join(_os2.path.dirname(bench.__file__),
+                       "BENCH_r05.json")) is None
     prev_rec, prev_file = bench._prev_bench_record()
-    assert prev_rec and prev_file.startswith("BENCH_r")
+    assert prev_rec and (prev_file.startswith("BENCH_r")
+                         or prev_file == bench.BENCH_LATEST)
     assert "value" in prev_rec and "attn_fwdbwd_ms_L8192" in prev_rec
 
 
@@ -152,10 +172,10 @@ def test_tricks_off_builds_unfused_reference_layout():
 
 
 def test_resolve_attention_seq_length_routing(monkeypatch, devices8):
-    """'' auto-resolution (r5, measured crossover): dense at seq<=256 on
-    TPU (99.8 vs 111.9 ms/step at bs256/seq256 once dense prob-dropout
-    went through the hash engine), flash beyond, ring under an sp axis,
-    dense off-TPU; explicit --attention always wins."""
+    """'' auto-resolution (r6, measured 2D crossover surface): dense at
+    seq<=256 on TPU while the materialized probs fit the routing memory
+    budget, flash beyond either bound, ring under an sp axis, dense
+    off-TPU; explicit --attention always wins."""
     from faster_distributed_training_tpu.cli import resolve_attention
     from faster_distributed_training_tpu.config import TrainConfig
     from faster_distributed_training_tpu.parallel import make_mesh
@@ -165,15 +185,76 @@ def test_resolve_attention_seq_length_routing(monkeypatch, devices8):
         TrainConfig(seq_len=256, batch_size=256)) == "dense"
     assert resolve_attention(
         TrainConfig(seq_len=512, batch_size=256)) == "flash"
-    # outside the measured envelope (probs memory scales with B): flash
+    # r6 2D surface: large batches stay dense at short seq while the
+    # probs fit (attn_route_* bench arms), flash past the memory bound
+    assert resolve_attention(
+        TrainConfig(seq_len=128, batch_size=512)) == "dense"
+    assert resolve_attention(
+        TrainConfig(seq_len=128, batch_size=1024)) == "dense"
+    assert resolve_attention(
+        TrainConfig(seq_len=256, batch_size=512)) == "dense"
+    # bs1024/seq256: 3*4*B*H*L^2 = 6.4 GB probs > the 4 GB budget
     assert resolve_attention(
         TrainConfig(seq_len=256, batch_size=1024)) == "flash"
+    # seq=384 sits past the L-crossover (flash from seq>=384 up)
+    assert resolve_attention(
+        TrainConfig(seq_len=384, batch_size=256)) == "flash"
+    # the memory-headroom env override flips the bound, not the code
+    monkeypatch.setenv("FDT_DENSE_ATTN_BUDGET_MB", "8192")
+    assert resolve_attention(
+        TrainConfig(seq_len=256, batch_size=1024)) == "dense"
+    monkeypatch.setenv("FDT_DENSE_ATTN_BUDGET_MB", "0")
+    assert resolve_attention(
+        TrainConfig(seq_len=128, batch_size=64)) == "flash"
+    monkeypatch.delenv("FDT_DENSE_ATTN_BUDGET_MB")
     assert resolve_attention(TrainConfig(seq_len=512,
                                          attention="dense")) == "dense"
     sp_mesh = make_mesh(("dp", "sp"), (1, 8), devices8)
     assert resolve_attention(TrainConfig(seq_len=2048), sp_mesh) == "ring"
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     assert resolve_attention(TrainConfig(seq_len=512)) == "dense"
+
+
+def test_attn_route_surface_cells_cite_measured_arms():
+    """VERDICT r5 #5 acceptance: every cell the 2D routing surface
+    serves cites a bench arm that bench.py actually measures — either an
+    attn_route_* cell in bench.ATTN_ROUTE_BENCH_CELLS or a tracked
+    transformer arm present in the committed BENCH_LATEST.json."""
+    import importlib.util
+    import json as _json
+    import os as _os
+    import re as _re
+
+    from faster_distributed_training_tpu.cli import (_ATTN_ROUTE_SURFACE,
+                                                     _dense_attn_fits)
+
+    here = _os.path.join(_os.path.dirname(__file__), "..")
+    spec = importlib.util.spec_from_file_location(
+        "bench", _os.path.join(here, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    with open(_os.path.join(here, "BENCH_LATEST.json")) as fh:
+        latest = _json.load(fh)
+
+    assert _ATTN_ROUTE_SURFACE, "routing surface must not be empty"
+    for bs, seq, impl, arm in _ATTN_ROUTE_SURFACE:
+        if arm.startswith("attn_route_"):
+            m = _re.match(r"attn_route_bs(\d+)_seq(\d+)_(\w+?)_step_ms$",
+                          arm)
+            assert m, arm
+            abs_, aseq, aimpl = int(m.group(1)), int(m.group(2)), m.group(3)
+            assert (abs_, aseq) == (bs, seq), (arm, bs, seq)
+            cell = {c[:2]: c[2] for c in bench.ATTN_ROUTE_BENCH_CELLS}
+            assert (bs, seq) in cell, f"{arm}: no bench arm for cell"
+            assert aimpl in cell[(bs, seq)], f"{arm}: impl not measured"
+        else:
+            # r5-measured cells ride the round-tracked transformer arms
+            assert arm in latest, f"{arm} not in BENCH_LATEST.json"
+        # the surface's impl must agree with what resolve_attention's
+        # rule actually returns for the cell (table and code in sync)
+        expect = ("dense" if seq <= 256 and _dense_attn_fits(bs, seq, 8)
+                  else "flash")
+        assert impl == expect, (bs, seq, impl, expect)
 
 
 def test_ffn_impl_pallas_mesh_routing(devices8):
