@@ -17,6 +17,9 @@ SuperbTUM/Faster-Distributed-Training (reference surveyed in SURVEY.md):
   with a native C++ decode/augment core (``data/``, ``runtime/``)
 - checkpoint/resume of full training state (params, optimizer incl. Fisher
   factors, RNG, step), profiling, metrics, plotting (``train/``, ``utils/``)
+- fault tolerance: async + preemption-aware step-cadence checkpointing,
+  a self-restarting supervisor, deterministic fault injection and
+  goodput accounting (``resilience/``)
 
 Import alias convention used throughout docs and tests::
 

@@ -564,15 +564,113 @@ def run_training(cfg: TrainConfig,
     put_train = make_put_batch(mesh, train_augment)
     put_eval = make_put_batch(mesh, eval_augment)
 
+    from faster_distributed_training_tpu.resilience import (Preempted,
+                                                            Supervisor,
+                                                            build_resilience)
+    from faster_distributed_training_tpu.train.metrics import attach_goodput
+
+    if cfg.supervise and not (cfg.checkpoint_every
+                              or cfg.checkpoint_every_secs):
+        # a supervisor without restore points can only replay from scratch;
+        # default to one step-cadence save per epoch
+        cfg = cfg.replace(checkpoint_every=steps_per_epoch)
+        log(f"[resilience] --supervise without a checkpoint cadence: "
+            f"defaulting --checkpoint_every to {steps_per_epoch} "
+            f"(one save per epoch)")
+    res = build_resilience(cfg, log=log)
+    if res is not None and cfg.donate and jax.default_backend() == "cpu":
+        # Measured (r7): on jaxlib 0.4.x's CPU client, a checkpoint
+        # restore followed by donating the state back into the compiled
+        # step corrupts the heap (glibc "corrupted double-linked list" /
+        # SIGSEGV at the first post-restore step) — the donated-buffer
+        # dealloc bug class the `donate` flag exists to route around.
+        # Resilient runs make restore-then-continue a NORMAL path rather
+        # than a manual --resume rarity, so the CPU backend (the test/
+        # gate simulator, never the perf path) trades donation away;
+        # TPU keeps both donation and resilience.
+        cfg = cfg.replace(donate=False)
+        log("[resilience] CPU backend: buffer donation disabled for this "
+            "run (restore-then-donate corrupts the jaxlib 0.4.x CPU "
+            "client's heap; TPU runs keep donation)")
+
     ckpt_name = "transformer" if is_text else "resnet"
+    preempted = False
     with mesh:
         trainer = Trainer(cfg, put_batch=put_train,
                           put_eval_batch=put_eval, log=log,
-                          state_shardings=shardings)
+                          state_shardings=shardings, resilience=res)
         state, start_epoch = trainer.maybe_resume(state, ckpt_name)
+
+        def attempt(restart_index: int):
+            """One training attempt: resume from the newest VALID
+            step-cadence checkpoint when one exists (crash recovery AND
+            process-restart recovery share this path), else from the
+            epoch-checkpoint/fresh state.
+
+            Deliberately NOT gated on --resume: after a preemption the
+            platform re-runs the same command, and that re-launch must
+            pick up the emergency checkpoint unaided (the standard
+            production-manager semantic).  Corollary, documented in the
+            README: a checkpoint_dir with step checkpoints in it always
+            resumes — re-running a COMPLETED run's command is an
+            (intentional) idempotent no-op; point --checkpoint_dir at a
+            fresh directory for a fresh run."""
+            st, ep, sie = state, start_epoch, 0
+            if res is not None and res.manager is not None:
+                prev_step = trainer.global_step
+                got = res.manager.restore_latest(st)
+                if got is not None:
+                    st, meta = got
+                    ep = int(meta.get("epoch", 0))
+                    sie = int(meta.get("step_in_epoch", 0))
+                    trainer.best_acc = float(meta.get("best_acc",
+                                                      trainer.best_acc))
+                    step = int(meta.get("step", 0))
+                    log(f"[resume] restored step-cadence checkpoint: "
+                        f"step {step} (epoch {ep}, batch {sie})")
+                    if restart_index > 0 and prev_step > step:
+                        # rollback badput: steps re-run because the newest
+                        # checkpoint predates the crash, costed at the
+                        # run's observed productive step time
+                        s = res.goodput.summary()
+                        if s["steps"]:
+                            res.goodput.add(
+                                "rollback_lost_s",
+                                (prev_step - step)
+                                * s["productive_s"] / s["steps"])
+                elif cfg.supervise and restart_index == 0:
+                    # seed a step-0 restore point so a crash before the
+                    # first cadence save is still recoverable (the donated
+                    # live state can't serve as one)
+                    res.manager.save(st, 0, epoch=ep, step_in_epoch=0,
+                                     best_acc=trainer.best_acc)
+            return trainer.fit(st, train_loader, eval_loader,
+                               ckpt_name=ckpt_name, start_epoch=ep,
+                               start_step_in_epoch=sie)
+
         with trace_profile("./profile" if cfg.profile else None):
-            state = trainer.fit(state, train_loader, eval_loader,
-                                ckpt_name=ckpt_name, start_epoch=start_epoch)
+            try:
+                if res is not None and cfg.supervise:
+                    sup = Supervisor(max_restarts=cfg.max_restarts,
+                                     goodput=res.goodput, log=log)
+                    state = sup.run(attempt,
+                                    progress=lambda: trainer.global_step)
+                else:
+                    state = attempt(0)
+            except Preempted as p:
+                preempted = True
+                if p.state is not None:
+                    state = p.state
+                log(f"[preempt] training stopped cleanly at step {p.step}; "
+                    f"re-launch with the same --checkpoint_dir to resume")
+            finally:
+                # even when training dies for good (supervisor budget
+                # exhausted, deterministic crash re-raise): drain the
+                # in-flight async save and give the SIGTERM/SIGINT
+                # handlers back — a long-lived caller must not inherit a
+                # swallowed Ctrl-C or a thread still writing checkpoints
+                if res is not None:
+                    res.close()
 
     if cfg.plot and jax.process_index() == 0 and trainer.history["test_acc"]:
         prefix = ckpt_name
@@ -580,8 +678,12 @@ def run_training(cfg: TrainConfig,
                    f"{prefix} test accuracy", f"{prefix}_accuracy.png")
         draw_graph(trainer.history["epoch_time"], "seconds",
                    f"{prefix} epoch time", f"{prefix}_time.png")
-    return {"state": state, "history": trainer.history,
-            "best_acc": trainer.best_acc, "cfg": cfg}
+    out = {"state": state, "history": trainer.history,
+           "best_acc": trainer.best_acc, "cfg": cfg}
+    if res is not None:
+        out["preempted"] = preempted
+        attach_goodput(out, res.goodput)
+    return out
 
 
 def main(argv=None, defaults: Optional[TrainConfig] = None,

@@ -145,6 +145,27 @@ class TrainConfig:
                                       # ngd_optimizer.py:46, which it never
                                       # turns on)
 
+    # -- resilience (resilience/ package; all off by default) --------------
+    checkpoint_every: int = 0         # async step-cadence checkpoints every
+                                      # N train steps (0 = epoch-level only)
+    checkpoint_every_secs: float = 0.0  # ... and/or every S seconds of wall
+                                      # clock, whichever fires first
+    checkpoint_keep: int = 3          # keep-last-K retention for the
+                                      # step-cadence checkpoints
+    checkpoint_async: bool = True     # off-critical-path saves (snapshot on
+                                      # the step thread, serialize + commit
+                                      # in the background); forced sync for
+                                      # multi-host runs (collective save)
+    supervise: bool = False           # wrap the train loop in the bounded-
+                                      # retry supervisor: on a crash, restore
+                                      # the newest valid checkpoint and
+                                      # continue (resilience/supervisor.py)
+    max_restarts: int = 3             # supervisor restart budget
+    preempt_sync_every: int = 8       # steps between cross-host preemption
+                                      # agreement collectives (multi-host
+                                      # only; bounds SIGTERM-to-save latency
+                                      # vs per-step allgather cost)
+
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
 
@@ -240,6 +261,31 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--auto_recover", action="store_true",
                    help="on a non-finite epoch loss, restore the last good "
                         "checkpoint and keep training")
+    p.add_argument("--checkpoint_every", default=d.checkpoint_every, type=int,
+                   help="async step-cadence checkpoints every N train steps "
+                        "(keep-last-K, atomic commit markers, preemption-"
+                        "aware; 0 = epoch-level checkpoints only)")
+    p.add_argument("--checkpoint_every_secs", default=d.checkpoint_every_secs,
+                   type=float,
+                   help="wall-clock checkpoint cadence in seconds (combines "
+                        "with --checkpoint_every; whichever fires first)")
+    p.add_argument("--checkpoint_keep", default=d.checkpoint_keep, type=int,
+                   help="how many step-cadence checkpoints to retain")
+    p.add_argument("--sync_checkpoint", action="store_true",
+                   help="disable the async (off-critical-path) checkpoint "
+                        "write; saves block the step loop instead")
+    p.add_argument("--supervise", action="store_true",
+                   help="self-restarting supervisor: on a crash, restore "
+                        "the newest valid checkpoint and continue with "
+                        "exponential backoff (bounded by --max_restarts; "
+                        "deterministic crashes re-raise immediately)")
+    p.add_argument("--max_restarts", default=d.max_restarts, type=int,
+                   help="supervisor restart budget")
+    p.add_argument("--preempt_sync_every", default=d.preempt_sync_every,
+                   type=int,
+                   help="steps between cross-host preemption-agreement "
+                        "collectives (multi-host; lower = faster SIGTERM-"
+                        "to-emergency-save, higher = less sync overhead)")
     p.add_argument("--debug", action="store_true",
                    help="per-epoch NGD Fisher invariant self-tests")
     p.add_argument("--seq_len", default=d.seq_len, type=int,
@@ -319,6 +365,12 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         log_every=args.log_every,
         plot=not args.no_plot,
         auto_recover=args.auto_recover, debug=args.debug,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_every_secs=args.checkpoint_every_secs,
+        checkpoint_keep=args.checkpoint_keep,
+        checkpoint_async=not args.sync_checkpoint,
+        supervise=args.supervise, max_restarts=args.max_restarts,
+        preempt_sync_every=args.preempt_sync_every,
         seq_len=args.seq_len, n_layers=args.n_layers, d_model=args.d_model,
         d_ff=args.d_ff, n_heads=args.n_heads, attention=args.attention,
         mlp_impl=args.mlp_impl, ffn_impl=args.ffn_impl,

@@ -1,0 +1,143 @@
+"""Deterministic fault injection — the resilience test harness.
+
+Faults are armed through env vars (so the CPU test suite and the
+preemption smoke script can inject into an unmodified training process)
+and fire at exact host-side step/batch counters, never randomly:
+
+  * ``FDT_FAULT_DIE_AT_STEP=N``      — raise :class:`InjectedFault` after
+    global step N completes (a crash the supervisor should recover);
+  * ``FDT_FAULT_SIGTERM_AT_STEP=N``  — deliver a real SIGTERM to this
+    process after step N (exercises the preemption handler + emergency
+    save end-to-end, signal delivery included);
+  * ``FDT_FAULT_DATA_AT_BATCH=K``    — raise from inside the data
+    iterator at batch index K of every epoch (exercises the prefetch
+    pipeline's error propagation and the supervisor above it).
+
+Each fault fires ONCE per process: after a supervisor restart the
+replayed step must succeed, otherwise every injected crash would look
+deterministic (same step failing twice) and the supervisor would
+correctly — but uselessly for testing — re-raise.
+
+``corrupt_newest_checkpoint`` is the storage-fault arm: tests call it
+directly to damage a committed checkpoint and assert the manager falls
+back to the previous valid one."""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Iterable, Iterator, Optional
+
+ENV_DIE = "FDT_FAULT_DIE_AT_STEP"
+ENV_SIGTERM = "FDT_FAULT_SIGTERM_AT_STEP"
+ENV_DATA = "FDT_FAULT_DATA_AT_BATCH"
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected failure — semantically a crash, so
+    nothing catches it specially: it must flow through the exact
+    recovery path a real fault would."""
+
+
+def _env_int(env: dict, key: str) -> Optional[int]:
+    raw = env.get(key)
+    if raw in (None, ""):
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"malformed {key}={raw!r}: want an integer step")
+
+
+class FaultPlan:
+    def __init__(self, die_at: Optional[int] = None,
+                 sigterm_at: Optional[int] = None,
+                 data_at: Optional[int] = None):
+        self.die_at = die_at
+        self.sigterm_at = sigterm_at
+        self.data_at = data_at
+        self._die_fired = False
+        self._sigterm_fired = False
+        self._data_fired = False
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> Optional["FaultPlan"]:
+        """The armed plan, or None when no FDT_FAULT_* is set (the
+        common case — callers skip every per-step hook)."""
+        die = _env_int(env, ENV_DIE)
+        sig = _env_int(env, ENV_SIGTERM)
+        data = _env_int(env, ENV_DATA)
+        if die is None and sig is None and data is None:
+            return None
+        return cls(die_at=die, sigterm_at=sig, data_at=data)
+
+    def on_step(self, step: int) -> None:
+        """Called by the train loop after each completed global step."""
+        if (self.sigterm_at is not None and step >= self.sigterm_at
+                and not self._sigterm_fired):
+            self._sigterm_fired = True
+            # a REAL signal to this process: the preemption handler's
+            # delivery path is part of what the harness exercises
+            os.kill(os.getpid(), signal.SIGTERM)
+        if (self.die_at is not None and step >= self.die_at
+                and not self._die_fired):
+            self._die_fired = True
+            raise InjectedFault(f"injected crash at global step {step}")
+
+    def wrap_data(self, iterable: Iterable) -> Iterator:
+        """Data-iterator fault: yields batches until index `data_at`,
+        then raises from INSIDE the iterator — through PrefetchIterator /
+        ParallelBatchIterator this lands in the consumer thread exactly
+        like a real loader failure."""
+        if self.data_at is None:
+            yield from iterable
+            return
+        for i, item in enumerate(iterable):
+            if i >= self.data_at and not self._data_fired:
+                self._data_fired = True
+                raise InjectedFault(
+                    f"injected data-iterator failure at batch {i}")
+            yield item
+
+
+def corrupt_newest_checkpoint(directory: str, prefix: str = "ckpt",
+                              mode: str = "truncate") -> Optional[str]:
+    """Damage the newest COMMITTED `<prefix>_step_*` checkpoint under
+    `directory`; returns its path (None when there is none).
+
+    mode="truncate": halve the largest data file — the commit marker
+    stays intact, so validity checks pass but the restore fails
+    (bit-rot / torn-block simulation; the manager must fall back).
+    mode="unmark": delete BOTH completion markers (ours and orbax's) —
+    the half-written-directory shape has_checkpoint() must reject (a
+    directory from a non-atomic writer killed mid-save has neither)."""
+    from faster_distributed_training_tpu.resilience.manager import (
+        AsyncCheckpointManager)
+    from faster_distributed_training_tpu.train import checkpoint as ckpt
+
+    mgr = AsyncCheckpointManager(directory, prefix=prefix,
+                                 log=lambda *_: None)
+    newest = mgr.latest_valid()
+    if newest is None:
+        return None
+    path = os.path.join(directory, newest[1])
+    if mode == "unmark":
+        for marker in (ckpt._COMMIT, ckpt._OCP_METADATA):
+            p = os.path.join(path, marker)
+            if os.path.exists(p):
+                os.remove(p)
+        return path
+    if mode != "truncate":
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    largest, size = None, -1
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            p = os.path.join(root, f)
+            s = os.path.getsize(p)
+            if s > size:
+                largest, size = p, s
+    if largest is None:
+        raise RuntimeError(f"no data files under {path}")
+    with open(largest, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+    return path

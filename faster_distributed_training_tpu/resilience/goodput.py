@@ -1,0 +1,86 @@
+"""Goodput/badput accounting — how much wall time actually trained.
+
+The methodology mirrors Google's ML Goodput accounting: total wall time
+splits into PRODUCTIVE time (steps that contributed to the final model)
+and BADPUT categories — checkpoint-save blocking, emergency preemption
+saves, restore time, supervisor restart backoff, and progress lost to a
+rollback (steps re-run because the newest checkpoint predated the
+crash).  Everything here is host-side bookkeeping: a few float adds per
+event, nothing per-step on the hot path.
+
+Consumed by: the Trainer (epoch ``[goodput]`` log line via
+``train/metrics.py:attach_goodput``), ``cli.run_training`` (summary in
+the result dict), and the ``ckpt_*`` arms in bench.py (checkpoint
+overhead per step, async vs sync vs off)."""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+# badput wall-time segments (seconds); anything not in a segment while
+# the clock runs is counted productive
+_SEGMENTS = ("checkpoint_blocking_s", "emergency_save_s", "restore_s",
+             "restart_backoff_s", "rollback_lost_s")
+# event counters
+_COUNTERS = ("saves", "skipped_saves", "save_failures", "restores",
+             "restarts", "preemptions", "steps")
+
+
+class GoodputTracker:
+    """Accumulates badput segments + event counters against a wall clock
+    started at :meth:`start` (idempotent — the first caller wins, so the
+    supervisor's clock spans every retry)."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._t0: Optional[float] = None
+        self._seg: Dict[str, float] = {k: 0.0 for k in _SEGMENTS}
+        self._cnt: Dict[str, int] = {k: 0 for k in _COUNTERS}
+
+    def start(self) -> "GoodputTracker":
+        if self._t0 is None:
+            self._t0 = self._clock()
+        return self
+
+    def add(self, segment: str, seconds: float) -> None:
+        if segment not in self._seg:
+            raise KeyError(f"unknown badput segment {segment!r}; "
+                           f"want one of {_SEGMENTS}")
+        self._seg[segment] += float(seconds)
+
+    def count(self, counter: str, n: int = 1) -> None:
+        if counter not in self._cnt:
+            raise KeyError(f"unknown counter {counter!r}; "
+                           f"want one of {_COUNTERS}")
+        self._cnt[counter] += n
+
+    @contextmanager
+    def timed(self, segment: str):
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self.add(segment, self._clock() - t0)
+
+    def summary(self) -> Dict[str, float]:
+        """One flat dict: wall/badput/productive seconds, goodput %, and
+        the event counters.  Safe to call before start() (all zeros)."""
+        total = (self._clock() - self._t0) if self._t0 is not None else 0.0
+        badput = sum(self._seg.values())
+        productive = max(total - badput, 0.0)
+        out: Dict[str, float] = {
+            "wall_s": round(total, 3),
+            "productive_s": round(productive, 3),
+            "badput_s": round(badput, 3),
+            "goodput_pct": round(100.0 * productive / total, 2) if total
+            else 100.0,
+        }
+        for k, v in self._seg.items():
+            out[k] = round(v, 3)
+        out.update(self._cnt)
+        if self._cnt["steps"]:
+            out["productive_step_ms"] = round(
+                productive / self._cnt["steps"] * 1e3, 3)
+        return out

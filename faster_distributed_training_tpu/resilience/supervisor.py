@@ -1,0 +1,90 @@
+"""Self-restarting supervisor: bounded-retry, backoff, no futile loops.
+
+Wraps the train loop (cli.run_training builds the ``attempt`` closure:
+restore from the newest VALID checkpoint via the manager, then
+``Trainer.fit`` from there).  Policy:
+
+  * a crash triggers a restart after exponential backoff (base·2^k,
+    capped) — transient faults (flaky storage, a dying host being
+    rescheduled) get room to clear;
+  * restarts are BOUNDED (``max_restarts`` total) — a run that keeps
+    dying is surfaced, not silently retried forever;
+  * DETERMINISTIC crashes short-circuit: if two consecutive attempts
+    fail at the same global step, the bug reproduces on replay (bad
+    batch, NaN-poisoned state older than every checkpoint, code bug) and
+    retrying is futile — the original exception re-raises immediately,
+    with retries still in budget;
+  * :class:`Preempted` passes straight through — an emergency save
+    already landed and the PLATFORM owns the restart, so retrying
+    in-process would fight the scheduler for the grace window.
+
+The supervisor knows nothing about jax or checkpoints — it sequences
+``attempt``/``progress`` callables, which is what makes it testable with
+plain functions and reusable by the smoke script."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+from faster_distributed_training_tpu.resilience import Preempted
+
+
+class Supervisor:
+    def __init__(self, max_restarts: int = 3, backoff_base: float = 1.0,
+                 backoff_cap: float = 30.0, goodput=None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 log: Callable[[str], None] = print):
+        self.max_restarts = int(max_restarts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self._goodput = goodput
+        self._sleep = sleep
+        self._log = log
+
+    def run(self, attempt: Callable[[int], Any],
+            progress: Callable[[], Optional[int]]) -> Any:
+        """attempt(restart_index) runs one training attempt (index 0 is
+        the first run; the closure re-restores on every call so attempt
+        k resumes from whatever checkpoint is newest AFTER failure k-1).
+        progress() reports the global step reached, read after a failure
+        for the deterministic-crash check."""
+        last_fail_step: Optional[int] = None
+        restarts = 0
+        while True:
+            try:
+                return attempt(restarts)
+            except Preempted:
+                raise                       # clean shutdown, never retried
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                step = progress()
+                if last_fail_step is not None and step == last_fail_step:
+                    self._log(
+                        f"[supervisor] step {step} failed twice in a row — "
+                        f"the crash is deterministic (reproduces on replay "
+                        f"from the same checkpoint); re-raising instead of "
+                        f"looping")
+                    raise
+                restarts += 1
+                if restarts > self.max_restarts:
+                    self._log(f"[supervisor] giving up after "
+                              f"{self.max_restarts} restarts "
+                              f"(last failure at step {step}: {e!r})")
+                    raise
+                delay = min(self.backoff_cap,
+                            self.backoff_base * 2.0 ** (restarts - 1))
+                self._log(f"[supervisor] attempt {restarts - 1} failed at "
+                          f"step {step} ({e!r}); restarting from the newest "
+                          f"valid checkpoint in {delay:.1f}s "
+                          f"({restarts}/{self.max_restarts})")
+                if self._goodput:
+                    self._goodput.count("restarts")
+                if delay > 0:
+                    if self._goodput:
+                        with self._goodput.timed("restart_backoff_s"):
+                            self._sleep(delay)
+                    else:
+                        self._sleep(delay)
+                last_fail_step = step

@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import time
 import warnings
 from typing import Any, Optional, Tuple
 
@@ -24,8 +25,28 @@ import orbax.checkpoint as ocp
 from faster_distributed_training_tpu.train.state import TrainState
 
 _META = "meta.json"
+# Commit marker: written LAST (atomically, process 0) after the arrays
+# AND meta.json are durably on disk.  Its presence is the "this
+# checkpoint is restorable" contract has_checkpoint() and the resilience
+# manager check — a bare directory (preemption mid-write) is never it.
+_COMMIT = "COMMIT"
+# orbax's own completion file: Checkpointer.save() stages into a tmp dir
+# and renames, writing this marker inside — pre-r7 checkpoints (incl.
+# the committed legacy fixture) carry it but not ours.
+_OCP_METADATA = "_CHECKPOINT_METADATA"
 
 _LEGACY_LAYER_KEY = re.compile(r"^(attn|ffn|ln_attn|ln_ffn)_(\d+)$")
+
+
+def _write_json_atomic(path: str, obj: Any) -> None:
+    """tmp + os.replace so a preemption mid-write can never leave a torn
+    file at `path` — the previous content (or absence) survives intact."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def migrate_legacy_transformer_params(model_params: Any,
@@ -87,15 +108,44 @@ def _state_pytree(state: TrainState) -> Any:
 
 
 def save_checkpoint(checkpoint_dir: str, name: str, state: TrainState,
-                    epoch: int, best_acc: float) -> str:
-    """Overwrites `<checkpoint_dir>/<name>` with the full state."""
+                    epoch: int, best_acc: float,
+                    extra_meta: Optional[dict] = None) -> str:
+    """Overwrites `<checkpoint_dir>/<name>` with the full state.
+
+    `state` may be a real TrainState or any object exposing the same
+    checkpointable attributes with HOST (numpy) leaves — the resilience
+    manager's async path saves a device_get snapshot this way."""
     path = _ckpt_dir(checkpoint_dir, name)
+    return save_pytree_checkpoint(
+        path, _state_pytree(state),
+        {"epoch": int(epoch), "best_acc": float(best_acc),
+         **(extra_meta or {})})
+
+
+def save_pytree_checkpoint(path: str, tree: Any, meta: dict) -> str:
+    """Shared save core: orbax arrays (atomic — staged + renamed), then
+    meta.json, then the COMMIT marker, both atomically and in that order
+    so the marker's presence implies everything before it is complete.
+    A preemption at ANY point leaves either the previous checkpoint
+    intact or an uncommitted directory has_checkpoint() rejects."""
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
-        ckptr.save(path, _state_pytree(state), force=True)
+        ckptr.save(path, tree, force=True)
     if jax.process_index() == 0:
-        with open(os.path.join(path, _META), "w") as f:
-            json.dump({"epoch": int(epoch), "best_acc": float(best_acc)}, f)
+        _write_json_atomic(os.path.join(path, _META), meta)
+        _write_json_atomic(os.path.join(path, _COMMIT),
+                           {"committed_unix_time": round(time.time(), 3)})
     return path
+
+
+def read_checkpoint_meta(checkpoint_dir: str, name: str) -> dict:
+    """The meta.json contents ({} when absent/torn — a torn file is
+    impossible post-r7, but pre-r7 checkpoints wrote it non-atomically)."""
+    meta_path = os.path.join(_ckpt_dir(checkpoint_dir, name), _META)
+    try:
+        with open(meta_path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
 
 
 def restore_checkpoint(checkpoint_dir: str, name: str, state: TrainState
@@ -117,12 +167,9 @@ def restore_checkpoint(checkpoint_dir: str, name: str, state: TrainState
         # meaningfully folded (Fisher factors/momenta were tracked per
         # UNFUSED kernel), so it restarts fresh — loudly.
         restored = _restore_legacy(path, template, structural)
-    meta_path = os.path.join(path, _META)
-    epoch, best_acc = 0, 0.0
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
-        epoch, best_acc = int(meta["epoch"]), float(meta["best_acc"])
+    meta = read_checkpoint_meta(checkpoint_dir, name)
+    epoch = int(meta.get("epoch", 0))
+    best_acc = float(meta.get("best_acc", 0.0))
     state = state.replace(
         step=restored["step"], params=restored["params"],
         batch_stats=restored["batch_stats"], opt_state=restored["opt_state"],
@@ -257,5 +304,25 @@ def _fit_or_template(raw_sub: Any, template_sub: Any, label: str) -> Any:
         return template_sub
 
 
+def is_committed(path: str) -> bool:
+    """True iff `path` holds a COMPLETE checkpoint.
+
+    Post-r7 saves: the COMMIT marker (written last — arrays AND meta.json
+    durably on disk).  Pre-r7 saves are grandfathered via orbax's own
+    completion metadata, but ONLY together with meta.json: a post-r7
+    save killed between orbax's staged-rename and the meta write leaves
+    `_CHECKPOINT_METADATA` with no meta.json, and restoring that torn
+    state would default epoch/step to 0 and silently replay the run from
+    the start.  A bare directory — a preemption mid-write — is nothing."""
+    if os.path.exists(os.path.join(path, _COMMIT)):
+        return True
+    return (os.path.exists(os.path.join(path, _OCP_METADATA))
+            and os.path.exists(os.path.join(path, _META)))
+
+
 def has_checkpoint(checkpoint_dir: str, name: str) -> bool:
-    return os.path.isdir(_ckpt_dir(checkpoint_dir, name))
+    """A *restorable* checkpoint exists — not merely a directory.  The
+    bare-isdir check it replaces returned True for half-written
+    directories, sending --resume into a crash on the next restore."""
+    path = _ckpt_dir(checkpoint_dir, name)
+    return os.path.isdir(path) and is_committed(path)

@@ -13,6 +13,7 @@ transformer_test.py:205-347).  Differences by design:
 
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
@@ -21,7 +22,8 @@ import jax
 from faster_distributed_training_tpu.config import TrainConfig
 from faster_distributed_training_tpu.data.loader import device_prefetch
 from faster_distributed_training_tpu.train import checkpoint as ckpt
-from faster_distributed_training_tpu.train.metrics import MetricAccumulator
+from faster_distributed_training_tpu.train.metrics import (MetricAccumulator,
+                                                           format_goodput)
 from faster_distributed_training_tpu.train.state import TrainState
 from faster_distributed_training_tpu.train.steps import (make_eval_step,
                                                          make_train_step)
@@ -43,8 +45,12 @@ class Trainer:
     def __init__(self, cfg: TrainConfig, put_batch: Optional[Callable] = None,
                  put_eval_batch: Optional[Callable] = None,
                  log: Callable[[str], None] = print,
-                 state_shardings=None):
+                 state_shardings=None, resilience=None):
         self.cfg = cfg
+        # resilience.Resilience bundle (or None = zero hot-path overhead):
+        # step-cadence async checkpoints, preemption handling, fault
+        # injection, goodput accounting — resilience/__init__.py
+        self.resilience = resilience
         self.put_batch = put_batch or (lambda b: b)
         # eval staging may differ (e.g. normalize-only augmentation);
         # defaults to the train staging function
@@ -64,14 +70,34 @@ class Trainer:
             "test_loss": [], "epoch_time": []}
         self.best_acc = 0.0
         self.recoveries = 0
+        # host-side mirror of state.step: reading the device scalar per
+        # step would force a sync, so the loop counts steps itself
+        # (re-anchored to the real value at every fit()/restore)
+        self.global_step = 0
 
     def run_epoch(self, state: TrainState, loader: Iterable,
-                  epoch: int = 0) -> tuple:
+                  epoch: int = 0, start_step: int = 0) -> tuple:
         acc = MetricAccumulator()
         t0 = time.monotonic()
         metrics = None
-        n = 0
-        last_t, last_n = t0, 0
+        res = self.resilience
+        if res is not None and res.faults is not None:
+            loader = res.faults.wrap_data(loader)
+        if start_step:
+            # mid-epoch resume: the checkpoint landed after `start_step`
+            # batches of this epoch; the loader's order is a pure function
+            # of (seed, epoch), so skipping that many batches replays the
+            # remainder exactly.  Batches are materialized to be skipped
+            # (the loader API yields, it doesn't seek) — host-side work
+            # only, no device steps.
+            it = iter(loader)
+            for _ in itertools.islice(it, start_step):
+                pass
+            loader = it
+            self.log(f"[resume] epoch {epoch}: skipped {start_step} "
+                     f"already-trained batches")
+        n = start_step
+        last_t, last_n = t0, start_step
         # --log_every N: a live loss/accuracy/throughput line every N
         # steps — the reference's tqdm descriptor observability
         # (resnet50_test.py:560-566) at 1/N its sync cost (tqdm's
@@ -86,6 +112,9 @@ class Trainer:
             state, metrics = self.train_step(state, batch)
             acc.add(metrics)
             n += 1
+            self.global_step += 1
+            if res is not None:
+                state = self._resilience_hooks(state, epoch, n)
             if log_every and n % log_every == 0:
                 loss = float(metrics["loss"])
                 correct = metrics.get("correct")
@@ -109,6 +138,56 @@ class Trainer:
             float(metrics["loss"])
         elapsed = time.monotonic() - t0
         return state, acc.summary(), elapsed
+
+    def _resilience_hooks(self, state: TrainState, epoch: int,
+                          step_in_epoch: int) -> TrainState:
+        """Per-step resilience work, in hazard order: injected faults
+        first (a crash preempts bookkeeping, like the real thing), then
+        the cross-host-agreed preemption decision (emergency save +
+        clean Preempted exit), then cadence checkpointing."""
+        res = self.resilience
+        step = self.global_step
+        res.goodput.count("steps")
+        if res.faults is not None:
+            res.faults.on_step(step)    # may SIGTERM this process / raise
+        if res.preemption is not None and res.preemption.should_stop(step):
+            from faster_distributed_training_tpu.resilience import Preempted
+            res.goodput.count("preemptions")
+            if res.manager is not None:
+                # the manager bills the save's duration into the
+                # emergency_save_s segment itself — wrapping it in
+                # goodput.timed here too would double-count the badput
+                res.manager.save(state, step, epoch=epoch,
+                                 step_in_epoch=step_in_epoch,
+                                 best_acc=self.best_acc, sync=True,
+                                 segment="emergency_save_s")
+                self.log(f"[preempt] emergency checkpoint committed at "
+                         f"step {step} (epoch {epoch}); exiting cleanly")
+            else:
+                self.log(f"[preempt] no checkpoint manager configured — "
+                         f"exiting at step {step} WITHOUT an emergency "
+                         f"save (set --checkpoint_every to get one)")
+            raise Preempted(f"preempted at step {step}", state=state,
+                            step=step)
+        if res.manager is not None:
+            res.manager.maybe_save(state, step, epoch=epoch,
+                                   step_in_epoch=step_in_epoch,
+                                   best_acc=self.best_acc)
+        return state
+
+    def _save_epoch_checkpoint(self, name: str, state: TrainState,
+                               epoch: int) -> None:
+        """Epoch-level save (rolling last-good / best-acc), goodput-timed
+        when the resilience bundle is active."""
+        res = self.resilience
+        if res is not None:
+            with res.goodput.timed("checkpoint_blocking_s"):
+                ckpt.save_checkpoint(self.cfg.checkpoint_dir, name, state,
+                                     epoch, self.best_acc)
+            res.goodput.count("saves")
+        else:
+            ckpt.save_checkpoint(self.cfg.checkpoint_dir, name, state,
+                                 epoch, self.best_acc)
 
     def evaluate(self, state: TrainState, loader: Iterable) -> Dict[str, float]:
         if self._offload_shardings is not None:
@@ -146,11 +225,24 @@ class Trainer:
 
     def fit(self, state: TrainState, train_loader: LoaderFn,
             eval_loader: LoaderFn, ckpt_name: str = "ckpt",
-            start_epoch: int = 0) -> TrainState:
+            start_epoch: int = 0, start_step_in_epoch: int = 0
+            ) -> TrainState:
         cfg = self.cfg
         self.recoveries = 0
         consecutive_failures = 0
         recover_name = ckpt_name + "_last"
+        res = self.resilience
+        # re-anchor the host step mirror to the device truth (one sync,
+        # once per fit — the restored step after a supervisor restart)
+        self.global_step = int(jax.device_get(state.step))
+        # supervisor restarts re-enter fit on the SAME Trainer and replay
+        # from the restored epoch: drop any history entries the replay
+        # will re-append, or plots/returned history would duplicate the
+        # rolled-back epochs
+        for series in self.history.values():
+            del series[start_epoch:]
+        if res is not None:
+            res.goodput.start()
         if cfg.auto_recover:
             # Rollback target is a ROLLING last-good snapshot, separate from
             # the best-accuracy checkpoint (which can be arbitrarily stale
@@ -159,26 +251,36 @@ class Trainer:
             # non-finite the live params are poisoned, "retry from current
             # state" can never converge — and (b) a stale snapshot from a
             # previous run in the same dir can never be resurrected.
-            ckpt.save_checkpoint(cfg.checkpoint_dir, recover_name, state,
-                                 start_epoch - 1, self.best_acc)
+            self._save_epoch_checkpoint(recover_name, state, start_epoch - 1)
         epoch = start_epoch
+        resume_step = start_step_in_epoch
         while epoch < cfg.epochs:
             state, train_m, elapsed = self.run_epoch(
-                state, train_loader(epoch), epoch)
+                state, train_loader(epoch), epoch, start_step=resume_step)
+            resumed_mid_epoch, resume_step = resume_step, 0
             # Failure detection (a deliberate addition — the reference's
             # only recovery is manual re-launch with --resume, SURVEY.md
             # §5): a non-finite epoch loss means the run is poisoned; roll
             # back to the last good checkpoint and keep going.
             if "loss" not in train_m:
-                # zero batches ran — a data/config problem (dataset smaller
-                # than one per-host batch, bad shard), not divergence;
-                # letting auto_recover roll back would burn recovery slots
-                # on an error a retry can never fix
-                raise RuntimeError(
-                    f"epoch {epoch} produced no batches — dataset too small "
-                    f"for batch_size={cfg.batch_size} x "
-                    f"{jax.process_count()} process(es)?")
-            if cfg.auto_recover and not _finite(train_m.get("loss")):
+                if resumed_mid_epoch:
+                    # the resume checkpoint landed after this epoch's LAST
+                    # train step (the pre-eval window): nothing to replay —
+                    # fall through to eval/bookkeeping and move on
+                    self.log(f"[resume] epoch {epoch} was already fully "
+                             f"trained at checkpoint time; running its "
+                             f"eval and continuing")
+                else:
+                    # zero batches ran — a data/config problem (dataset
+                    # smaller than one per-host batch, bad shard), not
+                    # divergence; letting auto_recover roll back would burn
+                    # recovery slots on an error a retry can never fix
+                    raise RuntimeError(
+                        f"epoch {epoch} produced no batches — dataset too "
+                        f"small for batch_size={cfg.batch_size} x "
+                        f"{jax.process_count()} process(es)?")
+            if ("loss" in train_m and cfg.auto_recover
+                    and not _finite(train_m.get("loss"))):
                 consecutive_failures += 1
                 if consecutive_failures > cfg.max_recoveries:
                     raise RuntimeError(
@@ -186,6 +288,8 @@ class Trainer:
                         f"a row (epoch {epoch}); giving up")
                 state, ck_epoch, _ = ckpt.restore_checkpoint(
                     cfg.checkpoint_dir, recover_name, state)
+                # rollback moved state.step — re-anchor the host mirror
+                self.global_step = int(jax.device_get(state.step))
                 self.log(f"[recover] non-finite loss at epoch {epoch}; "
                          f"restored last-good state from epoch {ck_epoch}, "
                          f"retrying")
@@ -203,8 +307,7 @@ class Trainer:
                 # refresh the rolling last-good snapshot after every finite
                 # epoch, so recovery rolls back one epoch, not to the last
                 # best-accuracy improvement
-                ckpt.save_checkpoint(cfg.checkpoint_dir, recover_name, state,
-                                     epoch, self.best_acc)
+                self._save_epoch_checkpoint(recover_name, state, epoch)
             if cfg.debug:
                 self._debug_checks(state, epoch)
             test_m = self.evaluate(state, eval_loader(epoch))
@@ -224,9 +327,14 @@ class Trainer:
             # best-acc-gated full-state checkpoint (resnet50_test.py:663-675)
             if test_m.get("accuracy", 0.0) > self.best_acc:
                 self.best_acc = test_m["accuracy"]
-                ckpt.save_checkpoint(cfg.checkpoint_dir, ckpt_name, state,
-                                     epoch, self.best_acc)
+                self._save_epoch_checkpoint(ckpt_name, state, epoch)
+            if res is not None:
+                self.log("[goodput] " + format_goodput(res.goodput))
             epoch += 1
+        if res is not None and res.manager is not None:
+            # drain any in-flight async save so a clean exit never leaves
+            # an uncommitted newest checkpoint behind
+            res.manager.wait()
         return state
 
     def _debug_checks(self, state: TrainState, epoch: int) -> None:

@@ -49,3 +49,36 @@ class MetricAccumulator:
 
     def reset(self) -> None:
         self._sums.clear()
+
+
+def attach_goodput(summary: Dict[str, float], tracker) -> Dict[str, float]:
+    """Merge a GoodputTracker snapshot into an epoch/run summary dict
+    under ``goodput_``-prefixed keys (resilience/goodput.py) — the
+    resilience subsystem's metrics ride the same summary surface as
+    loss/accuracy instead of a side channel.  No-op on tracker=None."""
+    if tracker is None:
+        return summary
+    for k, v in tracker.summary().items():
+        summary[f"goodput_{k}" if not k.startswith("goodput") else k] = v
+    return summary
+
+
+def format_goodput(tracker) -> str:
+    """One log line: `96.2% goodput (ckpt 0.8s block, 2 saves, 1 restore)`
+    — the Trainer's per-epoch [goodput] observability."""
+    s = tracker.summary()
+    bits = [f"{s['goodput_pct']:.1f}% goodput over {s['wall_s']:.1f}s"]
+    if s.get("checkpoint_blocking_s"):
+        bits.append(f"ckpt block {s['checkpoint_blocking_s']:.2f}s")
+    if s.get("emergency_save_s"):
+        bits.append(f"emergency save {s['emergency_save_s']:.2f}s")
+    if s.get("restore_s"):
+        bits.append(f"restore {s['restore_s']:.2f}s")
+    if s.get("restart_backoff_s"):
+        bits.append(f"backoff {s['restart_backoff_s']:.2f}s")
+    counts = ", ".join(f"{int(s[k])} {k.rstrip('s') if s[k] == 1 else k}"
+                       for k in ("saves", "skipped_saves", "restores",
+                                 "restarts", "preemptions") if s.get(k))
+    if counts:
+        bits.append(counts)
+    return "; ".join(bits)
