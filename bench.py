@@ -58,6 +58,16 @@ honest total cost; a median alone would exclude every save-bearing step
 and read 0% even for a fully blocking saver).  Opt out with
 FDT_BENCH_CKPT=0.
 
+Round-8 additions (host-free inner loop PR): the fused-dispatch ladder —
+transformer_bs256_seq256_k{1,4,16}_step_ms and resnet_bs512_k{1,4,16}_
+step_ms, the full train program on DEVICE-RESIDENT synthetic data with
+K steps per dispatch (steps.make_fused_train_step), K=1 being the
+dispatch-per-step floor on the same path — plus the input-pipeline A/B
+data_path_{host,resident}_step_ms (BatchLoader+prefetch+H2D vs resident
+in-graph gather, both at K=1, the only arms that INCLUDE steady-state
+data work).  All measured N-interleaved with *_noise_band_pct per the
+r6 protocol.  Opt out with FDT_BENCH_KDIS=0.
+
 Baseline: the reference publishes no absolute throughput (BASELINE.md).
 `vs_baseline` is value / FDT_BENCH_BASELINE (img/s/chip) when that env
 var is set; otherwise the constant 1.0 with "baseline_configured": false
@@ -462,6 +472,170 @@ def timed_checkpoint_overhead(mode: str, bs: int, steps: int) -> dict:
     return out
 
 
+def timed_fused(model: str, k: int, bs: int, seq: int, steps: int) -> dict:
+    """K-step fused dispatch arm (r8 tentpole): the full train program on
+    DEVICE-RESIDENT synthetic data, K steps per dispatch
+    (steps.make_fused_train_step over data/device_resident.py) — the
+    configuration whose per-step time the transformer_bs256_seq256_k{K}_
+    step_ms / resnet_bs512_k{K}_step_ms arms track.  The K=1 cell is the
+    dispatch-per-step floor on the SAME resident path, so the K ladder
+    isolates dispatch amortization from data-path effects; uint8 images
+    are augmented in-step (the real pipeline), tokens run as-is."""
+    import jax
+    import jax.numpy as jnp
+
+    from faster_distributed_training_tpu.cli import (build_model,
+                                                     enable_compilation_cache)
+    from faster_distributed_training_tpu.config import (TrainConfig,
+                                                        resolve_tricks)
+    from faster_distributed_training_tpu.data import (DeviceResidentData,
+                                                      synthetic_agnews,
+                                                      synthetic_cifar)
+    from faster_distributed_training_tpu.optim import build_optimizer
+    from faster_distributed_training_tpu.parallel import make_mesh
+    from faster_distributed_training_tpu.parallel.placement import (
+        shard_train_state)
+    from faster_distributed_training_tpu.train import (
+        create_train_state, make_fused_train_step)
+
+    enable_compilation_cache()
+    mesh = make_mesh(("dp",))
+    is_text = model == "transformer"
+    cfg = resolve_tricks(TrainConfig(
+        model=model, dataset="synthetic", num_classes=4 if is_text else 10,
+        batch_size=bs, seq_len=seq or 512, use_ngd=True, optimizer="ngd",
+        precision="bf16", epochs=1, steps_per_dispatch=k,
+        data_path="resident", tricks="on"))
+    # enough resident steps/epoch to cover ONE K-dispatch in-bounds
+    # (dynamic_slice would silently CLAMP an out-of-range start to the
+    # last window, re-training the final batch instead of wrapping);
+    # successive dispatches wrap the order via `span` below
+    n = bs * max(8, k)
+    if is_text:
+        ds = synthetic_agnews(n, max_len=seq)
+        resident = DeviceResidentData(ds, bs, seed=cfg.seed, max_len=seq,
+                                      mesh=mesh)
+        model_obj = build_model(cfg, vocab_size=ds.vocab_size(), mesh=mesh)
+        sample = jnp.zeros((bs, resident.seq_len), jnp.int32)
+    else:
+        ds = synthetic_cifar(n)
+        resident = DeviceResidentData(ds, bs, seed=cfg.seed, mesh=mesh)
+        model_obj = build_model(cfg)
+        sample = jnp.zeros((bs, 32, 32, 3), jnp.float32)
+    rng = jax.random.PRNGKey(cfg.seed)
+    tx, _ = build_optimizer(cfg, steps_per_epoch=resident.steps_per_epoch)
+    state = create_train_state(model_obj, tx, sample, rng,
+                               init_kwargs={"train": True})
+    with mesh:
+        state = shard_train_state(state, mesh, cfg)
+        fused = jax.jit(make_fused_train_step(cfg, k, resident=resident,
+                                              mesh=mesh), donate_argnums=0)
+        order = resident.epoch_order(0)
+        span = max(resident.steps_per_epoch - k + 1, 1)
+        n_dispatch = max(-(-steps // k), 1)
+        # warm past NGD's always-update phase (the Fisher refresh runs
+        # EVERY step while t < 10 — same policy as timed_resnet) and the
+        # compile, so the timed window is steady state
+        for w in range(max(2, -(-12 // k))):
+            state, metrics = fused(state, resident.arrays, order,
+                                   jnp.asarray(w % span, jnp.int32))
+        _fence(metrics)
+        t0 = time.monotonic()
+        for d in range(n_dispatch):
+            state, metrics = fused(state, resident.arrays, order,
+                                   jnp.asarray((d * k) % span, jnp.int32))
+        _fence(metrics)
+        return {"model": model, "k": k, "bs": bs, "seq": seq,
+                "elapsed": time.monotonic() - t0,
+                "steps_timed": n_dispatch * k}
+
+
+def timed_data_path(path: str, bs: int, steps: int) -> dict:
+    """data_path_{host,resident} A/B arm (r8 tentpole): the SAME ResNet
+    NGD train program fed by (a) the host pipeline — BatchLoader +
+    PrefetchIterator + device_prefetch staging, per-batch H2D — or (b)
+    the device-resident path (split uploaded once, batches gathered
+    in-graph), both at steps_per_dispatch=1 so the delta is purely the
+    input path, not dispatch fusion.  Includes ALL steady-state data
+    work, which the synthetic-device-array arms above deliberately
+    exclude."""
+    import jax
+    import jax.numpy as jnp
+
+    from faster_distributed_training_tpu.cli import (build_model,
+                                                     enable_compilation_cache)
+    from faster_distributed_training_tpu.config import (TrainConfig,
+                                                        resolve_tricks)
+    from faster_distributed_training_tpu.data import (BatchLoader,
+                                                      DeviceResidentData,
+                                                      PrefetchIterator,
+                                                      synthetic_cifar)
+    from faster_distributed_training_tpu.data.loader import device_prefetch
+    from faster_distributed_training_tpu.optim import build_optimizer
+    from faster_distributed_training_tpu.parallel import make_mesh
+    from faster_distributed_training_tpu.parallel.placement import (
+        make_put_batch, shard_train_state)
+    from faster_distributed_training_tpu.train import (
+        create_train_state, make_fused_train_step, make_train_step)
+
+    enable_compilation_cache()
+    mesh = make_mesh(("dp",))
+    cfg = resolve_tricks(TrainConfig(
+        model="resnet50", batch_size=bs, use_ngd=True, optimizer="ngd",
+        precision="bf16", epochs=1, data_path=path, tricks="on"))
+    data = synthetic_cifar(bs * 8)
+    rng = jax.random.PRNGKey(cfg.seed)
+    sample = jnp.zeros((bs, 32, 32, 3), jnp.float32)
+    tx, _ = build_optimizer(cfg, steps_per_epoch=8)
+    model_obj = build_model(cfg)
+    state = create_train_state(model_obj, tx, sample, rng,
+                               init_kwargs={"train": True})
+    with mesh:
+        state = shard_train_state(state, mesh, cfg)
+        if path == "resident":
+            resident = DeviceResidentData(data, bs, seed=cfg.seed,
+                                          mesh=mesh)
+            fused = jax.jit(make_fused_train_step(cfg, 1, resident=resident,
+                                                  mesh=mesh),
+                            donate_argnums=0)
+            order = resident.epoch_order(0)
+            for w in range(12):      # past NGD's always-update phase
+                state, metrics = fused(state, resident.arrays, order,
+                                       jnp.asarray(w % 8, jnp.int32))
+            _fence(metrics)
+            t0 = time.monotonic()
+            for i in range(steps):
+                state, metrics = fused(state, resident.arrays, order,
+                                       jnp.asarray(i % 8, jnp.int32))
+            _fence(metrics)
+            elapsed = time.monotonic() - t0
+        else:
+            put = make_put_batch(mesh)
+            step = jax.jit(make_train_step(cfg), donate_argnums=0)
+
+            def stream():
+                epoch = 0
+                while True:
+                    loader = PrefetchIterator(
+                        BatchLoader(data, bs, epoch=epoch, seed=cfg.seed),
+                        depth=cfg.prefetch_depth)
+                    yield from device_prefetch(loader, put,
+                                               depth=cfg.prefetch_depth)
+                    epoch += 1
+
+            it = stream()
+            for _ in range(12):
+                state, metrics = step(state, next(it))
+            _fence(metrics)
+            t0 = time.monotonic()
+            for _ in range(steps):
+                state, metrics = step(state, next(it))
+            _fence(metrics)
+            elapsed = time.monotonic() - t0
+    return {"path": path, "bs": bs, "elapsed": elapsed,
+            "steps_timed": steps}
+
+
 BENCH_LATEST = "BENCH_LATEST.json"
 
 
@@ -796,6 +970,22 @@ def main() -> None:
         print(json.dumps(timed_checkpoint_overhead(
             child[len("ckpt_"):], cbs, csteps)))
         return
+    if child.startswith("kdis_"):
+        # r8 fused-dispatch ladder: one (model, K) cell per child
+        _, m, kk = child.split("_")
+        ksteps = int(os.environ.get("FDT_BENCH_K_STEPS", "32"))
+        if m == "tf":
+            print(json.dumps(timed_fused("transformer", int(kk), 256, 256,
+                                         ksteps)))
+        else:
+            print(json.dumps(timed_fused("resnet50", int(kk), 512, 0,
+                                         ksteps)))
+        return
+    if child.startswith("datapath_"):
+        dsteps = int(os.environ.get("FDT_BENCH_K_STEPS", "32"))
+        print(json.dumps(timed_data_path(child[len("datapath_"):], 512,
+                                         dsteps)))
+        return
     if child == "eval_tf":
         print(json.dumps(timed_eval("transformer", 256, 256, tf_steps)))
         return
@@ -1066,6 +1256,48 @@ def main() -> None:
                     record[f"ckpt_{m}_amortized_overhead_pct"] = round(
                         (ck[m]["mean_step_ms"] - ck["off"]["mean_step_ms"])
                         / ck["off"]["mean_step_ms"] * 100.0, 2)
+        # K-step fused dispatch ladder + data-path A/B (r8 tentpole):
+        # per-step time at K in {1, 4, 16} on the device-resident path
+        # for both workloads, and the host-vs-resident input-pipeline
+        # A/B at K=1.  Measured N times INTERLEAVED (r6 noise protocol):
+        # medians published, observed range beside them as
+        # *_noise_band_pct feeding the regression guard's thresholds.
+        # Opt out with FDT_BENCH_KDIS=0.
+        if os.environ.get("FDT_BENCH_KDIS", "1") != "0":
+            def _k_name(m, kk):
+                return (f"transformer_bs256_seq256_k{kk}_step_ms"
+                        if m == "tf" else f"resnet_bs512_k{kk}_step_ms")
+
+            reps = max(1, int(os.environ.get("FDT_BENCH_K_REPEATS", "3")))
+            arms = [("tf", kk) for kk in (1, 4, 16)] \
+                + [("rn", kk) for kk in (1, 4, 16)]
+            k_runs = {a: [] for a in arms}
+            dp_runs = {p: [] for p in ("host", "resident")}
+            for _ in range(reps):
+                for m, kk in arms:
+                    r = _run_child(f"kdis_{m}_{kk}")
+                    if r:
+                        k_runs[(m, kk)].append(r)
+                for p in dp_runs:
+                    r = _run_child(f"datapath_{p}")
+                    if r:
+                        dp_runs[p].append(r)
+
+            def _publish(name, rs):
+                if not rs:
+                    return
+                ms = sorted(r["elapsed"] / r["steps_timed"] * 1e3
+                            for r in rs)
+                med = ms[len(ms) // 2]
+                record[name] = round(med, 3)
+                if len(ms) > 1 and med:
+                    record[name + "_noise_band_pct"] = round(
+                        (ms[-1] - ms[0]) / med * 100.0, 1)
+
+            for (m, kk), rs in k_runs.items():
+                _publish(_k_name(m, kk), rs)
+            for p, rs in dp_runs.items():
+                _publish(f"data_path_{p}_step_ms", rs)
         # Eval throughput under the guard (VERDICT r5 #7): the real
         # pad-and-mask eval step at each workload's headline shape.
         ev = _run_child("eval_resnet")
@@ -1098,7 +1330,8 @@ def main() -> None:
         full_run = (os.environ.get("FDT_BENCH_FAST") != "1"
                     and os.environ.get("FDT_BENCH_ATTN", "1") != "0"
                     and os.environ.get("FDT_BENCH_ROUTE", "1") != "0"
-                    and os.environ.get("FDT_BENCH_CKPT", "1") != "0")
+                    and os.environ.get("FDT_BENCH_CKPT", "1") != "0"
+                    and os.environ.get("FDT_BENCH_KDIS", "1") != "0")
         record["regressions"] = _find_regressions(record, prev,
                                                   check_missing=full_run)
     # Evidence chain (VERDICT r5 #1): persist the FULL record to a
@@ -1133,6 +1366,13 @@ def _essentials(record: dict) -> dict:
             "transformer_eval_ex_per_sec_bs256_seq256",
             "tricks_speedup_x", "ckpt_async_overhead_pct",
             "ckpt_async_amortized_overhead_pct",
+            "transformer_bs256_seq256_k1_step_ms",
+            "transformer_bs256_seq256_k4_step_ms",
+            "transformer_bs256_seq256_k16_step_ms",
+            "transformer_bs256_seq256_k4_step_ms_noise_band_pct",
+            "resnet_bs512_k1_step_ms", "resnet_bs512_k4_step_ms",
+            "resnet_bs512_k16_step_ms",
+            "data_path_host_step_ms", "data_path_resident_step_ms",
             "bench_unix_time", "regression_baseline_file")
     ess = {"essentials": True, "full_record": BENCH_LATEST}
     for k in keys:

@@ -543,26 +543,37 @@ def run_training(cfg: TrainConfig,
                  if cfg.host_offload else None)
     state = shard_train_state(state, mesh, cfg, shardings=shardings)
 
-    # device-side augmentation folded into batch staging (train only);
-    # the key advances per put so every batch sees fresh augmentation.
-    aug_counter = [0]
+    # TRAIN augmentation lives inside the train step now (steps.py):
+    # uint8 batches are crop/flip/normalized on device with the key
+    # derived from the CHECKPOINTED step counter — fold_in(PRNGKey(seed+1),
+    # state.step) — so a resumed run's augmentation stream is bitwise-
+    # identical to an uninterrupted one (the r7 ROADMAP gap: the old
+    # host-side aug_counter restarted at 0 on resume) and the K-step
+    # fused dispatch advances it with zero host involvement.  Train
+    # staging therefore uploads RAW uint8 (4x less H2D than the old
+    # augment-at-put float32); eval still normalizes at staging (no RNG).
     aug_key = jax.random.PRNGKey(cfg.seed + 1)
     aug = jax.jit(augment_batch, static_argnames=("train",))
-
-    def train_augment(batch):
-        if is_text or "image" not in batch:
-            return batch
-        aug_counter[0] += 1
-        k = jax.random.fold_in(aug_key, aug_counter[0])
-        return {**batch, "image": aug(k, batch["image"], train=True)}
 
     def eval_augment(batch):
         if is_text or "image" not in batch:
             return batch
         return {**batch, "image": aug(aug_key, batch["image"], train=False)}
 
-    put_train = make_put_batch(mesh, train_augment)
+    put_train = make_put_batch(mesh)
+    put_stacked = make_put_batch(mesh, stacked=True)
     put_eval = make_put_batch(mesh, eval_augment)
+
+    # --data_path resident: the whole train split uploads once; the
+    # builder returns None (with a warning) on multi-host runs
+    from faster_distributed_training_tpu.data.device_resident import (
+        build_device_resident)
+    resident = build_device_resident(cfg, train_ds, mesh=mesh)
+    if resident is not None:
+        log(f"[data] device-resident train split: {resident.n} samples, "
+            f"{resident.nbytes / 1e6:.0f} MB in HBM, "
+            f"{resident.steps_per_epoch} steps/epoch"
+            + (f", seq_len={resident.seq_len}" if resident.is_text else ""))
 
     from faster_distributed_training_tpu.resilience import (Preempted,
                                                             Supervisor,
@@ -577,6 +588,20 @@ def run_training(cfg: TrainConfig,
         log(f"[resilience] --supervise without a checkpoint cadence: "
             f"defaulting --checkpoint_every to {steps_per_epoch} "
             f"(one save per epoch)")
+    # K-step fused dispatch: the checkpoint/preemption cadence only
+    # polls at dispatch boundaries, so the save cadence must quantize to
+    # a multiple of K (rounded UP — never save more often than asked)
+    k = max(int(cfg.steps_per_dispatch or 1), 1)
+    if k > 1 and cfg.checkpoint_every and cfg.checkpoint_every % k:
+        rounded = -(-cfg.checkpoint_every // k) * k
+        import warnings
+        warnings.warn(
+            f"--checkpoint_every {cfg.checkpoint_every} is not a multiple "
+            f"of --steps_per_dispatch {k}; rounding up to {rounded} "
+            f"(checkpoints land on dispatch boundaries)", stacklevel=2)
+        log(f"[ckpt] checkpoint_every rounded {cfg.checkpoint_every} -> "
+            f"{rounded} (multiple of steps_per_dispatch={k})")
+        cfg = cfg.replace(checkpoint_every=rounded)
     res = build_resilience(cfg, log=log)
     if res is not None and cfg.donate and jax.default_backend() == "cpu":
         # Measured (r7): on jaxlib 0.4.x's CPU client, a checkpoint
@@ -598,7 +623,8 @@ def run_training(cfg: TrainConfig,
     with mesh:
         trainer = Trainer(cfg, put_batch=put_train,
                           put_eval_batch=put_eval, log=log,
-                          state_shardings=shardings, resilience=res)
+                          state_shardings=shardings, resilience=res,
+                          put_stacked=put_stacked, resident=resident)
         state, start_epoch = trainer.maybe_resume(state, ckpt_name)
 
         def attempt(restart_index: int):

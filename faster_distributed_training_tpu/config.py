@@ -85,6 +85,21 @@ class TrainConfig:
     seq_len: int = 512                # transformer max length
     seq_buckets: Tuple[int, ...] = (64, 128, 256, 512)
     prefetch_depth: int = 2
+    data_path: str = "host"           # host | resident: "resident" uploads
+                                      # the whole train split to device once
+                                      # (uint8 images / int32 token ids) and
+                                      # gathers each batch inside the jitted
+                                      # dispatch (data/device_resident.py);
+                                      # single-host only — multi-host falls
+                                      # back to host with a warning
+    steps_per_dispatch: int = 1       # K: train steps fused into one device
+                                      # dispatch via lax.scan (steps.py
+                                      # make_fused_train_step); 1 = today's
+                                      # one-dispatch-per-step loop.
+                                      # checkpoint/preemption cadence
+                                      # quantizes to dispatch boundaries
+                                      # (checkpoint_every rounds UP to a
+                                      # multiple of K, warned)
 
     # -- transformer architecture (reference defaults, transformer.py:12-35)
     n_layers: int = 6
@@ -288,6 +303,20 @@ def build_parser(prog: str = "fdt",
                         "to-emergency-save, higher = less sync overhead)")
     p.add_argument("--debug", action="store_true",
                    help="per-epoch NGD Fisher invariant self-tests")
+    p.add_argument("--data_path", default=d.data_path,
+                   choices=["host", "resident"],
+                   help="input pipeline: host = BatchLoader + prefetch + "
+                        "per-batch H2D (default), resident = whole train "
+                        "split uploaded to device once and batches "
+                        "gathered inside the jitted dispatch (single-host "
+                        "only; zero steady-state host work)")
+    p.add_argument("--steps_per_dispatch", default=d.steps_per_dispatch,
+                   type=int,
+                   help="K train steps fused into one device dispatch "
+                        "(lax.scan); 1 = the classic per-step loop.  K>1 "
+                        "amortizes Python dispatch + resilience polling "
+                        "K-fold; checkpoint cadence rounds up to a "
+                        "multiple of K")
     p.add_argument("--seq_len", default=d.seq_len, type=int,
                    help="transformer max sequence length")
     p.add_argument("--n_layers", default=d.n_layers, type=int)
@@ -371,6 +400,8 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         checkpoint_async=not args.sync_checkpoint,
         supervise=args.supervise, max_restarts=args.max_restarts,
         preempt_sync_every=args.preempt_sync_every,
+        data_path=args.data_path,
+        steps_per_dispatch=args.steps_per_dispatch,
         seq_len=args.seq_len, n_layers=args.n_layers, d_model=args.d_model,
         d_ff=args.d_ff, n_heads=args.n_heads, attention=args.attention,
         mlp_impl=args.mlp_impl, ffn_impl=args.ffn_impl,

@@ -13,5 +13,7 @@ from faster_distributed_training_tpu.data.loader import (  # noqa: F401
     verify_host_shards_global)
 from faster_distributed_training_tpu.data.augment import (  # noqa: F401
     augment_batch, normalize)
+from faster_distributed_training_tpu.data.device_resident import (  # noqa: F401,E501
+    DeviceResidentData, build_device_resident)
 from faster_distributed_training_tpu.data.agnews import (  # noqa: F401
     AGNewsDataset, clean_text)

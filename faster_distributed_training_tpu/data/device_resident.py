@@ -1,0 +1,130 @@
+"""Device-resident train datasets: upload once, batch in-graph.
+
+Both reference workloads are small enough to live in HBM whole —
+CIFAR-10 train is 50000·32·32·3 uint8 ≈ 147 MB raw but ~37 MB for the
+subset-strided configs the tuning harness runs, AG News at seq≤256 is
+~50 MB of int32 token ids — so the steady-state input pipeline does not
+need a host at all: the split is uploaded ONCE per run as compact
+dtypes (uint8 images, int32 token ids) and every batch is assembled
+*inside* the jitted train dispatch by an index gather
+(``train.steps.make_fused_train_step``).  This removes the per-step
+host work that bounds small-model step time (Murray et al., *tf.data*,
+2021): the ``BatchLoader`` gather, the per-batch ``device_put``, and
+the Python dispatch itself (amortized K× further by
+``--steps_per_dispatch``).
+
+Epoch semantics are the host loader's EXACTLY: the per-epoch order is
+the same ``shard_for_host(n, epoch, seed)`` permutation ``BatchLoader.
+plan()`` draws — a pure function of ``(seed, epoch)``, which is the
+determinism contract the resilience bitwise-resume tests pin.  The
+order is computed host-side once per EPOCH (an O(n) permutation and a
+~4·n-byte upload — noise against an epoch of steps) rather than by an
+in-graph ``jax.random.permutation``: threefry cannot reproduce numpy's
+``default_rng((seed, epoch))`` stream, and bit-identical batch order
+between the host and resident paths is a pinned test contract
+(tests/test_fused_dispatch.py).
+
+Text: the whole split is pre-encoded at ONE fixed bucket length (the
+smallest ``seq_buckets`` entry covering the longest sequence, ≤
+``max_len``) instead of the host path's per-batch bucketing — a single
+compiled program over the epoch, trading pad FLOPs for zero host work.
+
+Multi-host is deliberately unsupported (cli falls back to the host
+path with a warning): residency would have to be per-host sharded —
+each process holding only its shard — before ``process_count > 1``
+runs could use it without replicating the split into every host's HBM
+and re-deriving the per-host slice in-graph (README "Host-free inner
+loop" records this as the open item)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from faster_distributed_training_tpu.data.loader import (dataset_len,
+                                                         shard_for_host)
+
+
+class DeviceResidentData:
+    """The train split as device arrays + per-epoch order uploads.
+
+    ``arrays`` is a dict of device arrays with a leading sample axis
+    (images: ``image`` uint8 NHWC + ``label`` int32; text: ``tokens``/
+    ``token_types``/``mask``/``label`` int32), replicated over the mesh
+    (every chip gathers its own batch shard from the full split).
+    ``epoch_order(epoch)`` returns the epoch's device-resident index
+    array — ``steps_per_epoch * batch_size`` int32 entries in exactly
+    ``BatchLoader.plan()``'s order."""
+
+    def __init__(self, data, batch_size: int, seed: int = 0,
+                 max_len: int = 512, mesh=None, shuffle: bool = True):
+        if jax.process_count() > 1:
+            raise ValueError(
+                "device-resident datasets are single-host only (per-host "
+                "sharded residency is an open item); use the host data "
+                "path for multi-host runs")
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+        self.n = dataset_len(data)
+        self.steps_per_epoch = self.n // self.batch_size
+        if self.steps_per_epoch < 1:
+            raise ValueError(
+                f"dataset ({self.n} samples) smaller than one batch "
+                f"({self.batch_size}) — nothing to train on")
+        self.is_text = hasattr(data, "encode_batch")
+        if self.is_text:
+            # one fixed-length encoding of the whole split: the largest
+            # batch-bucketed length any (seed, epoch) schedule could draw
+            # is the bucket covering the split's longest sequence, so
+            # every host-path batch embeds into this shape (content
+            # equality modulo trailing padding — pinned by test)
+            host = {k: np.asarray(v) for k, v in
+                    data.encode_batch(np.arange(self.n), max_len).items()}
+            self.seq_len = int(host["tokens"].shape[1])
+        else:
+            x, y = data
+            host = {"image": np.asarray(x), "label": np.asarray(y)}
+            self.seq_len = 0
+        self.mesh = mesh
+        self._replicated = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._replicated = NamedSharding(mesh, PartitionSpec())
+        self.nbytes = sum(a.nbytes for a in host.values())
+        self.arrays: Dict[str, jax.Array] = {
+            k: self._put(v) for k, v in host.items()}
+
+    def _put(self, arr: np.ndarray) -> jax.Array:
+        if self._replicated is not None:
+            return jax.device_put(arr, self._replicated)
+        return jax.device_put(arr)
+
+    def epoch_order(self, epoch: int) -> jax.Array:
+        """The epoch's sample order as a device int32 array, truncated to
+        whole batches — elementwise equal to concatenating
+        ``BatchLoader.plan()``'s index entries for the same
+        ``(seed, epoch)`` (single-process; drop-last)."""
+        idx = shard_for_host(self.n, epoch, self.seed, self.shuffle,
+                             process_index=0, process_count=1)
+        idx = idx[: self.steps_per_epoch * self.batch_size]
+        return self._put(np.ascontiguousarray(idx.astype(np.int32)))
+
+
+def build_device_resident(cfg, train_ds, mesh=None
+                          ) -> Optional[DeviceResidentData]:
+    """cfg-gated constructor: None (host path) unless
+    ``cfg.data_path == "resident"`` and the run is single-host."""
+    if getattr(cfg, "data_path", "host") != "resident":
+        return None
+    if jax.process_count() > 1:
+        import warnings
+        warnings.warn(
+            "--data_path resident is single-host only (per-host sharded "
+            "residency is an open item, see README); falling back to the "
+            "host data path", stacklevel=2)
+        return None
+    return DeviceResidentData(train_ds, cfg.batch_size, seed=cfg.seed,
+                              max_len=cfg.seq_len, mesh=mesh)

@@ -244,26 +244,62 @@ class BatchLoader:
 
 class PrefetchIterator:
     """Background-thread prefetch with a bounded queue — the
-    BackgroundGenerator role (resnet50_test.py:41-43)."""
+    BackgroundGenerator role (resnet50_test.py:41-43).
+
+    An abandoned iterator (consumer stops early — preemption mid-epoch,
+    an injected fault, a crashed train step) must not leave the worker
+    blocked forever on a full queue: every ``put`` polls a cancel event,
+    and :meth:`close` sets it, drains the queue so a blocked producer
+    wakes immediately, and joins the thread.  The Trainer closes its
+    epoch loader on any abnormal loop exit (train/loop.py)."""
 
     _DONE = object()
+    _PUT_POLL_S = 0.2
 
     def __init__(self, iterable: Iterable, depth: int = 2):
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._err: Optional[BaseException] = None
+        self._cancel = threading.Event()
 
         def worker():
             try:
                 for item in iterable:
-                    self._q.put(item)
+                    if not self._put(item):
+                        return      # cancelled: drop everything, no _DONE
+                                    # (close() owns the shutdown)
             except BaseException as e:  # propagate into the consumer
                 self._err = e
             finally:
-                self._q.put(self._DONE)
+                self._put(self._DONE)
 
         self._t = threading.Thread(target=worker, daemon=True)
         self._t.start()
         self._done = False
+
+    def _put(self, item) -> bool:
+        """Bounded put that gives up when the iterator is closed; returns
+        False iff cancelled (the item is dropped)."""
+        while not self._cancel.is_set():
+            try:
+                self._q.put(item, timeout=self._PUT_POLL_S)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def close(self) -> None:
+        """Cancel the worker and reclaim its thread.  Idempotent; safe
+        from the consumer at any point (including mid-iteration).  After
+        close() the iterator behaves as exhausted."""
+        self._cancel.set()
+        # drain so a producer blocked in put() frees up within one poll
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._t.join(timeout=5.0)
+        self._done = True
 
     def __iter__(self):
         return self

@@ -109,11 +109,17 @@ def shard_train_state(state, mesh: Mesh, cfg: TrainConfig, shardings=None):
 
 
 def make_put_batch(mesh: Optional[Mesh],
-                   augment_fn: Optional[Callable] = None
+                   augment_fn: Optional[Callable] = None,
+                   stacked: bool = False
                    ) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
     """Returns put_batch: host numpy dict -> global device arrays with the
     batch dim sharded over the data axes.  Each process contributes its
-    local shard (multi-host DistributedSampler semantics)."""
+    local shard (multi-host DistributedSampler semantics).
+
+    stacked=True stages K-step fused-dispatch super-batches: every leaf
+    carries a leading K (steps-per-dispatch) axis that stays UNsharded —
+    the lax.scan consumes it — and the batch axis below it shards over
+    the data axes as usual."""
     if mesh is None:
         if augment_fn is None:
             return lambda b: b
@@ -123,7 +129,11 @@ def make_put_batch(mesh: Optional[Mesh],
         out = {}
         for k, v in batch.items():
             v = np.asarray(v)
-            spec = batch_spec(mesh) if v.ndim >= 1 else P()
+            if stacked:
+                spec = (P(None, *batch_spec(mesh)) if v.ndim >= 2
+                        else P())
+            else:
+                spec = batch_spec(mesh) if v.ndim >= 1 else P()
             sharding = NamedSharding(mesh, spec)
             out[k] = jax.make_array_from_process_local_data(sharding, v)
         if augment_fn is not None:

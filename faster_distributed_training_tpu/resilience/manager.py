@@ -90,13 +90,31 @@ class AsyncCheckpointManager:
 
     def should_save(self, step: int) -> bool:
         """Multi-host, only the STEP term is live (a pure function of
-        step, identical on every host — what keeps the collective save
-        deadlock-free); the per-host wall-clock term is disabled at
-        construction there.  Single-process runs use both."""
+        the observed step sequence, identical on every host — what keeps
+        the collective save deadlock-free); the per-host wall-clock term
+        is disabled at construction there.  Single-process runs use both.
+
+        The step term fires when `step` has CROSSED an every_steps
+        boundary since the last save — exact multiples for the classic
+        per-step loop (identical behavior), and the first dispatch
+        boundary at-or-past each multiple under a K-step fused dispatch,
+        whose ticks only land at steps K, 2K, … (cli rounds
+        checkpoint_every up to a multiple of K so the two coincide; the
+        crossing form keeps cadence robust for epoch-tail dispatches of
+        size < K, which shift every later boundary off the multiples)."""
         if step <= 0 or step == self._last_save_step:
             return False
-        if self.every_steps and step % self.every_steps == 0:
-            return True
+        if self.every_steps:
+            anchor = self._last_save_step or 0
+            if anchor > step:
+                # the step counter moved BACKWARD (auto-recover rolled
+                # the state back to an epoch snapshot taken outside this
+                # manager): a stale forward anchor would silence the
+                # cadence for the whole replay window — reset so the
+                # replay is checkpointable immediately
+                anchor = 0
+            if step // self.every_steps > anchor // self.every_steps:
+                return True
         if self.every_secs:
             return time.monotonic() - self._last_save_t >= self.every_secs
         return False
@@ -142,6 +160,11 @@ class AsyncCheckpointManager:
         if self._inflight is not None and not self._inflight.done():
             if self._goodput:
                 self._goodput.count("skipped_saves")
+            # consume this cadence tick: without the anchor update the
+            # crossing-based should_save would re-fire EVERY subsequent
+            # step while the write runs, counting one skip per step
+            # instead of one per missed tick
+            self._last_save_step = step
             if not self._skip_logged:    # once per in-flight save, not per tick
                 self._skip_logged = True
                 self._log(f"[ckpt] step {step}: previous async save still "

@@ -38,6 +38,8 @@ class PreemptionHandler:
         self._flag = threading.Event()
         self._old = {}
         self._installed = False
+        self._last_polled = 0   # last step should_stop saw (multi-host
+                                # boundary-crossing sync cadence)
 
     # -- signal plumbing ---------------------------------------------------
 
@@ -98,7 +100,14 @@ class PreemptionHandler:
         agreement latency and the per-step collective cost."""
         if jax.process_count() == 1:
             return self._flag.is_set()
-        if step % self._sync_every:
+        # boundary-CROSSING, not exact modulo: with a K-step fused
+        # dispatch the poll only sees dispatch-boundary steps (K, 2K, …)
+        # which may never be exact multiples of sync_every; every host
+        # sees the SAME step sequence, so "crossed a sync boundary since
+        # the last poll" is still a pure function all hosts agree on.
+        prev = self._last_polled
+        self._last_polled = step
+        if step // self._sync_every <= prev // self._sync_every:
             return False
         from jax.experimental import multihost_utils
         bits = multihost_utils.process_allgather(

@@ -13,6 +13,6 @@ from faster_distributed_training_tpu.train.amp import (  # noqa: F401
 from faster_distributed_training_tpu.train.state import (  # noqa: F401
     TrainState, create_train_state)
 from faster_distributed_training_tpu.train.steps import (  # noqa: F401
-    make_eval_step, make_train_step)
+    make_eval_step, make_fused_train_step, make_train_step)
 from faster_distributed_training_tpu.train.loop import (  # noqa: F401
     Trainer)

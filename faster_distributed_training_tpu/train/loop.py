@@ -14,10 +14,12 @@ transformer_test.py:205-347).  Differences by design:
 from __future__ import annotations
 
 import itertools
+import math
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
 import jax
+import numpy as np
 
 from faster_distributed_training_tpu.config import TrainConfig
 from faster_distributed_training_tpu.data.loader import device_prefetch
@@ -25,18 +27,39 @@ from faster_distributed_training_tpu.train import checkpoint as ckpt
 from faster_distributed_training_tpu.train.metrics import (MetricAccumulator,
                                                            format_goodput)
 from faster_distributed_training_tpu.train.state import TrainState
-from faster_distributed_training_tpu.train.steps import (make_eval_step,
-                                                         make_train_step)
+from faster_distributed_training_tpu.train.steps import (
+    make_eval_step, make_fused_train_step, make_train_step)
 from faster_distributed_training_tpu.utils.profiling import peak_memory_bytes
 
 LoaderFn = Callable[[int], Iterable[Dict[str, Any]]]
 
 
 def _finite(x) -> bool:
+    """Host-side finiteness check on an ALREADY-FETCHED epoch metric
+    (MetricAccumulator.summary() returns Python floats).  Deliberately
+    not jax.numpy.isfinite: that would accept a still-on-device scalar
+    and add a blocking device round-trip at the epoch boundary."""
     try:
-        return x is not None and bool(jax.numpy.isfinite(x))
-    except Exception:
+        return x is not None and math.isfinite(float(x))
+    except (TypeError, ValueError):
         return False
+
+
+def _stack_host_batches(group: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """K host batches -> one dict with a leading K axis per leaf, ready
+    for a single staged transfer into the fused dispatch.  Text batches
+    bucketed to different widths within the group are right-padded to
+    the group max (tokens/token_types/mask all pad with 0 = ignore)."""
+    out = {}
+    for key in group[0]:
+        arrs = [np.asarray(b[key]) for b in group]
+        if any(a.shape != arrs[0].shape for a in arrs):
+            tgt = tuple(max(a.shape[d] for a in arrs)
+                        for d in range(arrs[0].ndim))
+            arrs = [np.pad(a, [(0, t - s) for s, t in zip(a.shape, tgt)])
+                    for a in arrs]
+        out[key] = np.stack(arrs)
+    return out
 
 
 class Trainer:
@@ -45,7 +68,8 @@ class Trainer:
     def __init__(self, cfg: TrainConfig, put_batch: Optional[Callable] = None,
                  put_eval_batch: Optional[Callable] = None,
                  log: Callable[[str], None] = print,
-                 state_shardings=None, resilience=None):
+                 state_shardings=None, resilience=None,
+                 put_stacked: Optional[Callable] = None, resident=None):
         self.cfg = cfg
         # resilience.Resilience bundle (or None = zero hot-path overhead):
         # step-cadence async checkpoints, preemption handling, fault
@@ -55,8 +79,22 @@ class Trainer:
         # eval staging may differ (e.g. normalize-only augmentation);
         # defaults to the train staging function
         self.put_eval_batch = put_eval_batch or self.put_batch
+        # staging for K-stacked host batches (leading K axis kept on-host;
+        # the batch dim below it is the sharded one) — placement.
+        # make_put_batch(..., stacked=True)
+        self.put_stacked = put_stacked or (lambda b: b)
+        # device-resident train split (data/device_resident.py) — when
+        # set, run_epoch never touches a host loader: batches are
+        # gathered inside the fused dispatch.  Eval stays on the host
+        # path (once per epoch, off the hot loop).
+        self.resident = resident
+        # K train steps per device dispatch (the fused lax.scan program);
+        # 1 keeps the classic one-jit-call-per-step loop bit-for-bit.
+        self.k = max(int(getattr(cfg, "steps_per_dispatch", 1) or 1), 1)
         self.log = log if jax.process_index() == 0 else (lambda *_: None)
         donate = {"donate_argnums": 0} if getattr(cfg, "donate", True) else {}
+        self._donate = donate
+        self._state_shardings = state_shardings
         # state_shardings is only needed for --host_offload (the train step
         # fetch/stashes the state across memory kinds per batch,
         # steps._offload_transfers; evaluate() fetches once per epoch)
@@ -64,6 +102,7 @@ class Trainer:
                                    else None)
         self.train_step = jax.jit(make_train_step(cfg, state_shardings),
                                   **donate)
+        self._fused_cache: Dict[tuple, Callable] = {}
         self.eval_step = jax.jit(make_eval_step(cfg))
         self.history: Dict[str, List[float]] = {
             "train_acc": [], "test_acc": [], "train_loss": [],
@@ -75,12 +114,35 @@ class Trainer:
         # (re-anchored to the real value at every fit()/restore)
         self.global_step = 0
 
-    def run_epoch(self, state: TrainState, loader: Iterable,
+    def _fused_step(self, kk: int, resident=None) -> Callable:
+        """Jitted K-step fused dispatch, cached per (path, kk) — an
+        epoch tail shorter than K compiles its own (one-off) program."""
+        key = ("resident" if resident is not None else "host", kk)
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            mesh = getattr(resident, "mesh", None)
+            fn = jax.jit(
+                make_fused_train_step(self.cfg, kk, self._state_shardings,
+                                      resident=resident, mesh=mesh),
+                **self._donate)
+            self._fused_cache[key] = fn
+        return fn
+
+    def run_epoch(self, state: TrainState, loader: Optional[Iterable],
                   epoch: int = 0, start_step: int = 0) -> tuple:
+        if self.resident is not None:
+            return self._run_epoch_resident(state, epoch, start_step)
+        if self.k > 1:
+            return self._run_epoch_fused_host(state, loader, epoch,
+                                              start_step)
         acc = MetricAccumulator()
         t0 = time.monotonic()
         metrics = None
         res = self.resilience
+        # keep a handle to the prefetch thread's cancel path BEFORE any
+        # wrapping: an abnormal loop exit (preemption, injected fault)
+        # must not strand the worker blocked on a full queue
+        closer = getattr(loader, "close", None)
         if res is not None and res.faults is not None:
             loader = res.faults.wrap_data(loader)
         if start_step:
@@ -104,31 +166,40 @@ class Trainer:
         # .item() reads synced EVERY batch; here one device->host
         # readback per N steps, 0 disables).
         log_every = int(self.cfg.log_every or 0)
-        # device_prefetch stages put_batch (H2D transfer + device-side
-        # augmentation dispatch) ahead of the consuming step — the
-        # pin_memory + non_blocking overlap (resnet50_test.py:522), TPU style
-        for batch in device_prefetch(loader, self.put_batch,
-                                     depth=self.cfg.prefetch_depth):
-            state, metrics = self.train_step(state, batch)
-            acc.add(metrics)
-            n += 1
-            self.global_step += 1
-            if res is not None:
-                state = self._resilience_hooks(state, epoch, n)
-            if log_every and n % log_every == 0:
-                loss = float(metrics["loss"])
-                correct = metrics.get("correct")
-                total = metrics.get("total")
-                now = time.monotonic()
-                exs = ((n - last_n) * self.cfg.batch_size
-                       / max(now - last_t, 1e-9))
-                line = f"[epoch {epoch}] step {n}: loss={loss:.4f}"
-                if correct is not None and total is not None:
-                    tot = float(total)
-                    if tot:
-                        line += f" acc={float(correct) / tot:.4f}"
-                self.log(line + f" {exs:.0f} ex/s")
-                last_t, last_n = now, n
+        # device_prefetch stages put_batch (H2D transfer ahead of the
+        # consuming step — the pin_memory + non_blocking overlap,
+        # resnet50_test.py:522, TPU style); uint8 image augmentation runs
+        # inside the step itself, keyed by the checkpointed step counter
+        try:
+            for batch in device_prefetch(loader, self.put_batch,
+                                         depth=self.cfg.prefetch_depth):
+                state, metrics = self.train_step(state, batch)
+                acc.add(metrics)
+                n += 1
+                self.global_step += 1
+                if res is not None:
+                    state = self._resilience_hooks(state, epoch, n)
+                if log_every and n % log_every == 0:
+                    loss = float(metrics["loss"])
+                    correct = metrics.get("correct")
+                    total = metrics.get("total")
+                    now = time.monotonic()
+                    exs = ((n - last_n) * self.cfg.batch_size
+                           / max(now - last_t, 1e-9))
+                    line = f"[epoch {epoch}] step {n}: loss={loss:.4f}"
+                    if correct is not None and total is not None:
+                        tot = float(total)
+                        if tot:
+                            line += f" acc={float(correct) / tot:.4f}"
+                    self.log(line + f" {exs:.0f} ex/s")
+                    last_t, last_n = now, n
+        except BaseException:
+            # stranded prefetch worker cleanup (Preempted, injected
+            # faults, Ctrl-C): cancel + join the loader's thread so an
+            # abandoned iterator can never block on a full queue forever
+            if closer is not None:
+                closer()
+            raise
         if metrics is not None:
             # fence with a device->host readback: on some PJRT backends
             # block_until_ready returns at dispatch, not completion
@@ -139,15 +210,131 @@ class Trainer:
         elapsed = time.monotonic() - t0
         return state, acc.summary(), elapsed
 
+    def _log_dispatch(self, epoch: int, n: int, kk: int, metrics,
+                      last) -> tuple:
+        """log_every at dispatch granularity: emit the live line whenever
+        this dispatch crossed a log_every boundary.  `last` is (t, n) of
+        the previous emission; returns the updated pair."""
+        log_every = int(self.cfg.log_every or 0)
+        if not log_every or (n // log_every) <= ((n - kk) // log_every):
+            return last
+        last_t, last_n = last
+        loss = float(metrics["loss"])
+        now = time.monotonic()
+        exs = (n - last_n) * self.cfg.batch_size / max(now - last_t, 1e-9)
+        line = f"[epoch {epoch}] step {n}: loss={loss:.4f}"
+        total = metrics.get("total")
+        correct = metrics.get("correct")
+        if correct is not None and total is not None and float(total):
+            line += f" acc={float(correct) / float(total):.4f}"
+        self.log(line + f" {exs:.0f} ex/s (K={kk} fused)")
+        return now, n
+
+    def _run_epoch_fused_host(self, state: TrainState, loader: Iterable,
+                              epoch: int, start_step: int = 0) -> tuple:
+        """K>1 on the host data path: group K host batches, stack them
+        into one leading-K transfer, advance K steps in one dispatch.
+        Kept mainly as the CPU-testable/bitwise-comparable twin of the
+        device-resident path (and for datasets that outgrow HBM) — the
+        zero-host-work pairing is --data_path resident."""
+        acc = MetricAccumulator()
+        t0 = time.monotonic()
+        metrics = None
+        res = self.resilience
+        closer = getattr(loader, "close", None)
+        if res is not None and res.faults is not None:
+            loader = res.faults.wrap_data(loader)
+        it = iter(loader)
+        if start_step:
+            # mid-epoch resume: saves land on dispatch boundaries, so
+            # start_step is a whole number of dispatches; the skipped
+            # batches are materialized host-side only (loader API yields)
+            for _ in itertools.islice(it, start_step):
+                pass
+            self.log(f"[resume] epoch {epoch}: skipped {start_step} "
+                     f"already-trained batches")
+        n = start_step
+        last = (t0, start_step)
+        try:
+            while True:
+                group = list(itertools.islice(it, self.k))
+                if not group:
+                    break
+                kk = len(group)
+                batch = self.put_stacked(_stack_host_batches(group))
+                state, metrics = self._fused_step(kk)(state, batch)
+                acc.add(metrics)
+                n += kk
+                self.global_step += kk
+                if res is not None:
+                    state = self._resilience_hooks(state, epoch, n,
+                                                   n_steps=kk)
+                last = self._log_dispatch(epoch, n, kk, metrics, last)
+        except BaseException:
+            if closer is not None:
+                closer()
+            raise
+        if metrics is not None:
+            float(metrics["loss"])     # fence (see run_epoch)
+        return state, acc.summary(), time.monotonic() - t0
+
+    def _run_epoch_resident(self, state: TrainState, epoch: int,
+                            start_step: int = 0) -> tuple:
+        """The host-free inner loop: the train split lives on device
+        (data/device_resident.py), the epoch order is uploaded once, and
+        each iteration is ONE jitted dispatch that gathers, augments and
+        trains K consecutive batches.  Steady-state per-dispatch host
+        work: a Python loop tick, one scalar arg, and the resilience
+        flag poll — no batch bytes, no permutation, no staging.
+
+        Data-iterator fault injection (FDT_FAULT_DATA_AT_BATCH) does not
+        apply here — there is no host iterator to wrap; step faults and
+        preemption inject exactly as on the host path."""
+        resident = self.resident
+        acc = MetricAccumulator()
+        t0 = time.monotonic()
+        metrics = None
+        res = self.resilience
+        order = resident.epoch_order(epoch)
+        n_steps = resident.steps_per_epoch
+        if start_step:
+            # device-resident resume is a pure SEEK: no host batches are
+            # materialized to skip — the next dispatch just starts at
+            # start_step's offset into the epoch order
+            self.log(f"[resume] epoch {epoch}: seeking to batch "
+                     f"{start_step} (device-resident order, no host "
+                     f"replay)")
+        n = start_step
+        last = (t0, start_step)
+        while n < n_steps:
+            kk = min(self.k, n_steps - n)
+            state, metrics = self._fused_step(kk, resident)(
+                state, resident.arrays, order,
+                jax.numpy.asarray(n, jax.numpy.int32))
+            acc.add(metrics)
+            n += kk
+            self.global_step += kk
+            if res is not None:
+                state = self._resilience_hooks(state, epoch, n,
+                                               n_steps=kk)
+            last = self._log_dispatch(epoch, n, kk, metrics, last)
+        if metrics is not None:
+            float(metrics["loss"])     # fence (see run_epoch)
+        return state, acc.summary(), time.monotonic() - t0
+
     def _resilience_hooks(self, state: TrainState, epoch: int,
-                          step_in_epoch: int) -> TrainState:
-        """Per-step resilience work, in hazard order: injected faults
-        first (a crash preempts bookkeeping, like the real thing), then
-        the cross-host-agreed preemption decision (emergency save +
-        clean Preempted exit), then cadence checkpointing."""
+                          step_in_epoch: int, n_steps: int = 1
+                          ) -> TrainState:
+        """Per-dispatch resilience work, in hazard order: injected
+        faults first (a crash preempts bookkeeping, like the real
+        thing), then the cross-host-agreed preemption decision
+        (emergency save + clean Preempted exit), then cadence
+        checkpointing.  `n_steps` = train steps this dispatch advanced
+        (K under the fused dispatch) so the goodput step counter stays
+        per-STEP while the polling stays per-dispatch."""
         res = self.resilience
         step = self.global_step
-        res.goodput.count("steps")
+        res.goodput.count("steps", n_steps)
         if res.faults is not None:
             res.faults.on_step(step)    # may SIGTERM this process / raise
         if res.preemption is not None and res.preemption.should_stop(step):
@@ -255,8 +442,13 @@ class Trainer:
         epoch = start_epoch
         resume_step = start_step_in_epoch
         while epoch < cfg.epochs:
+            # resident mode never builds a host train loader (it would
+            # spin up a prefetch thread and materialize batches nobody
+            # consumes); eval below stays on the host path either way
             state, train_m, elapsed = self.run_epoch(
-                state, train_loader(epoch), epoch, start_step=resume_step)
+                state,
+                None if self.resident is not None else train_loader(epoch),
+                epoch, start_step=resume_step)
             resumed_mid_epoch, resume_step = resume_step, 0
             # Failure detection (a deliberate addition — the reference's
             # only recovery is manual re-launch with --resume, SURVEY.md

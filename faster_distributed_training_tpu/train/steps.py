@@ -74,7 +74,18 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
 
     state_shardings: pass the TrainState-shaped sharding tree when
     cfg.host_offload is on — the step then round-trips the state
-    host->device->host per _offload_transfers."""
+    host->device->host per _offload_transfers.
+
+    Image augmentation happens IN-STEP when the batch carries raw uint8
+    images (the loaders' native dtype): the crop/flip/normalize key is
+    derived from the CHECKPOINTED device step counter
+    (``fold_in(PRNGKey(seed+1), state.step)``) instead of a host-side
+    counter, so (a) a resumed run's augmentation stream is bitwise-
+    identical to an uninterrupted one (ROADMAP "augmentation-stream
+    resume"), and (b) the fused K-step dispatch can advance the stream
+    on device with zero host involvement.  Pre-normalized float batches
+    (bench/synthetic probes, the eval staging path) pass through
+    untouched."""
     fp16 = cfg.precision == "fp16"
     is_text = cfg.model == "transformer"
     mode = resolve_mixup_mode(cfg)
@@ -86,10 +97,20 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
                          "(see parallel.placement.train_state_shardings)")
     fetch, stash = _offload_transfers(
         state_shardings if cfg.host_offload else None)
+    # the augmentation stream root — the same seed+1 derivation
+    # cli.run_training used for the host-counter stream it replaces
+    aug_root = jax.random.PRNGKey(cfg.seed + 1)
 
     def step(state: TrainState, batch: Dict[str, jax.Array]
              ) -> Tuple[TrainState, Metrics]:
         state = fetch(state)
+        if (not is_text and "image" in batch
+                and batch["image"].dtype == jnp.uint8):
+            from faster_distributed_training_tpu.data.augment import (
+                augment_batch)
+            k_aug = jax.random.fold_in(aug_root, state.step)
+            batch = dict(batch, image=augment_batch(
+                k_aug, batch["image"], train=True))
         step_key = jax.random.fold_in(state.rng, state.step)
         k_mix, k_drop = jax.random.split(step_key)
         if cfg.dropout_rng_impl == "rbg" and cfg.dropout_impl == "xla":
@@ -185,6 +206,93 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
         return stash(updated), metrics
 
     return step
+
+
+def _reduce_scanned_metrics(ms: Metrics) -> Metrics:
+    """Per-step metrics stacked [K] by lax.scan -> one on-device dict.
+
+    ``loss_total``/``total`` let MetricAccumulator.summary() recover the
+    EXACT sample-weighted epoch loss (identical to K=1's mean over equal-
+    sized steps); ``loss`` (mean over the dispatch) feeds the live
+    log-line and the non-finite epoch check — any non-finite step
+    poisons the mean, so divergence detection keeps per-step acuity."""
+    out = {"loss": jnp.mean(ms["loss"]),
+           "loss_total": jnp.sum(ms["loss"] * ms["total"]),
+           "correct": jnp.sum(ms["correct"]),
+           "total": jnp.sum(ms["total"])}
+    if "loss_scale" in ms:
+        out["loss_scale"] = jax.tree.map(lambda x: x[-1], ms["loss_scale"])
+    return out
+
+
+def make_fused_train_step(cfg: TrainConfig, k: int, state_shardings=None,
+                          resident=None, mesh=None) -> Callable:
+    """K steps in ONE device dispatch: ``lax.scan`` over the single-step
+    body (Kumar et al. 2021's loop-inside-the-program fix for dispatch-
+    bound small-model training).  The scan compiles the body ONCE and
+    calls it K times, so each iteration runs the same XLA program as the
+    standalone jitted step — which is what makes a K=4 run bitwise-equal
+    to a K=1 run at the same global step (pinned by
+    tests/test_fused_dispatch.py).  State is donated across the carry;
+    loss-scale/NGD/mixup state threads through unchanged (it all lives
+    in the carry); metrics are stacked by the scan and reduced on device
+    (_reduce_scanned_metrics).
+
+    Two batch sources:
+      * host (``resident=None``): ``step_k(state, batches)`` where every
+        batch leaf carries a leading K axis (the Trainer stacks K host
+        batches and stages them with ONE transfer);
+      * device-resident (``resident=DeviceResidentData``):
+        ``step_k(state, data, order, start)`` — batch ``start + i`` is
+        gathered from the resident split *inside* the scan body
+        (``order`` is the epoch's index array, ``start`` the dispatch's
+        first step-in-epoch), so the steady-state loop moves no batch
+        bytes from the host at all.
+
+    k == 1 is valid (one-step scan) but the Trainer keeps the plain
+    ``make_train_step`` path for it — the default behavior stays
+    byte-for-byte today's."""
+    base = make_train_step(cfg, state_shardings)
+    k = int(k)
+    if k < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+
+    if resident is None:
+        def step_k(state: TrainState, batches: Dict[str, jax.Array]
+                   ) -> Tuple[TrainState, Metrics]:
+            state, ms = lax.scan(base, state, batches, length=k)
+            return state, _reduce_scanned_metrics(ms)
+        return step_k
+
+    bs = resident.batch_size
+    constraint = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from faster_distributed_training_tpu.parallel.sharding import (
+            batch_spec)
+        constraint = NamedSharding(mesh, batch_spec(mesh))
+
+    def gather_batch(data: Dict[str, jax.Array], order: jax.Array,
+                     step_in_epoch: jax.Array) -> Dict[str, jax.Array]:
+        idx = lax.dynamic_slice_in_dim(order, step_in_epoch * bs, bs)
+        # indices come from a host-built permutation of [0, n) — always
+        # in bounds, so skip jnp.take's clamp/fill index normalization
+        batch = {kk: v.at[idx].get(mode="promise_in_bounds")
+                 for kk, v in data.items()}
+        if constraint is not None:
+            batch = {kk: lax.with_sharding_constraint(v, constraint)
+                     for kk, v in batch.items()}
+        return batch
+
+    def step_k(state: TrainState, data: Dict[str, jax.Array],
+               order: jax.Array, start: jax.Array
+               ) -> Tuple[TrainState, Metrics]:
+        def body(s, i):
+            return base(s, gather_batch(data, order, start + i))
+        state, ms = lax.scan(body, state, jnp.arange(k))
+        return state, _reduce_scanned_metrics(ms)
+
+    return step_k
 
 
 def make_eval_step(cfg: TrainConfig) -> Callable[[TrainState, Any],

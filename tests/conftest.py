@@ -54,6 +54,15 @@ jax.config.update("jax_threefry_partitionable", True)
 jax.config.update("jax_enable_x64", True)
 
 
+def pytest_configure(config):
+    # the tier-1 gate runs `-m 'not slow'` (ROADMAP): register the marker
+    # so opting heavy e2e twins out of the budget is not an unknown-mark
+    # warning
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 budgeted run "
+        "(ROADMAP's `-m 'not slow'`); run with `pytest -m slow`")
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()
