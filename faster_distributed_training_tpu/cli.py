@@ -55,6 +55,36 @@ def _host_isa_fingerprint() -> str:
     return hashlib.sha1(feat.encode()).hexdigest()[:8]
 
 
+def _jaxlib_version() -> str:
+    """The installed jaxlib's version string, "" when unavailable — the
+    single probe both the donation gate and its log line read, so the
+    two can't drift."""
+    try:
+        import jaxlib
+        return str(getattr(jaxlib, "__version__", ""))
+    except ImportError:
+        return ""
+
+
+def donation_workaround_needed(version: Optional[str] = None) -> bool:
+    """True when the jaxlib CPU client still carries the r7
+    restore-then-donate heap-corruption bug (measured+bisected on the
+    0.4.x line: glibc "corrupted double-linked list" / SIGSEGV at the
+    first post-restore donating step).  The ROADMAP said "retest when
+    jax moves past 0.4.x" — this predicate makes the retest automatic:
+    ``run_training`` re-enables donation the first time the container's
+    jaxlib reports a version past 0.4 (and logs which branch it took).
+    Unparseable/unknown versions keep the workaround: correctness over
+    a micro-optimization."""
+    if version is None:
+        version = _jaxlib_version()
+    import re as _re
+    m = _re.match(r"^\s*(\d+)\.(\d+)", str(version))
+    if not m:
+        return True
+    return (int(m.group(1)), int(m.group(2))) <= (0, 4)
+
+
 def _configured_platform() -> str:
     """The platform jax WILL use, read without initializing the backend
     (jax.default_backend() would pin the platform before setup_platform's
@@ -564,14 +594,19 @@ def run_training(cfg: TrainConfig,
     put_stacked = make_put_batch(mesh, stacked=True)
     put_eval = make_put_batch(mesh, eval_augment)
 
-    # --data_path resident: the whole train split uploads once; the
-    # builder returns None (with a warning) on multi-host runs
+    # --data_path resident: the train split uploads once — replicated on
+    # one host, per-host ROW-SHARDED on pods (each process's HBM holds
+    # only its ~n/process_count shard; one jitted re-shard per epoch
+    # builds the batch-major view the dispatch indexes locally)
     from faster_distributed_training_tpu.data.device_resident import (
         build_device_resident)
     resident = build_device_resident(cfg, train_ds, mesh=mesh)
     if resident is not None:
-        log(f"[data] device-resident train split: {resident.n} samples, "
-            f"{resident.nbytes / 1e6:.0f} MB in HBM, "
+        layout = ("sharded" if getattr(resident, "batch_major", False)
+                  else "replicated")
+        log(f"[data] device-resident train split ({layout}): "
+            f"{resident.n} samples, {resident.nbytes / 1e6:.0f} MB "
+            f"{'per-host shard' if layout == 'sharded' else 'in HBM'}, "
             f"{resident.steps_per_epoch} steps/epoch"
             + (f", seq_len={resident.seq_len}" if resident.is_text else ""))
 
@@ -611,12 +646,24 @@ def run_training(cfg: TrainConfig,
         # dealloc bug class the `donate` flag exists to route around.
         # Resilient runs make restore-then-continue a NORMAL path rather
         # than a manual --resume rarity, so the CPU backend (the test/
-        # gate simulator, never the perf path) trades donation away;
-        # TPU keeps both donation and resilience.
-        cfg = cfg.replace(donate=False)
-        log("[resilience] CPU backend: buffer donation disabled for this "
-            "run (restore-then-donate corrupts the jaxlib 0.4.x CPU "
-            "client's heap; TPU runs keep donation)")
+        # gate simulator, never the perf path) trades donation away on
+        # affected jaxlibs; TPU keeps both donation and resilience.  The
+        # workaround is VERSION-GATED (donation_workaround_needed): once
+        # the container's jaxlib moves past 0.4.x the retest is
+        # automatic — donation stays on and the log records it.
+        _jlv = _jaxlib_version() or "?"  # unparseable -> the predicate
+        #                                  keeps the workaround
+        if donation_workaround_needed(_jlv):
+            cfg = cfg.replace(donate=False)
+            log(f"[resilience] CPU backend on jaxlib {_jlv} (0.4.x-class): "
+                f"buffer donation disabled for this run (restore-then-"
+                f"donate corrupts this CPU client's heap; TPU runs keep "
+                f"donation — gate auto-re-enables past 0.4.x)")
+        else:
+            log(f"[resilience] CPU backend on jaxlib {_jlv} (> 0.4.x): "
+                f"r7 restore-then-donate workaround NOT applied — "
+                f"donation stays on (ROADMAP retest satisfied; if this "
+                f"run segfaults post-restore, re-open the workaround)")
 
     ckpt_name = "transformer" if is_text else "resnet"
     preempted = False

@@ -86,12 +86,27 @@ class TrainConfig:
     seq_buckets: Tuple[int, ...] = (64, 128, 256, 512)
     prefetch_depth: int = 2
     data_path: str = "host"           # host | resident: "resident" uploads
-                                      # the whole train split to device once
+                                      # the train split to device once
                                       # (uint8 images / int32 token ids) and
                                       # gathers each batch inside the jitted
                                       # dispatch (data/device_resident.py);
-                                      # single-host only — multi-host falls
-                                      # back to host with a warning
+                                      # works single-host (replicated) AND
+                                      # on pods (per-host sharded — see
+                                      # resident_layout)
+    resident_layout: str = "auto"     # auto | replicated | sharded: how the
+                                      # resident split is placed.  auto =
+                                      # replicated on one host (the r8
+                                      # layout, unchanged), per-host sharded
+                                      # on pods (each process uploads only
+                                      # its row shard; one jitted re-shard
+                                      # per epoch builds the batch-major
+                                      # view, so steady-state gathers are
+                                      # local-HBM dynamic_index reads).
+                                      # "sharded" forces the sharded layout
+                                      # even single-host (spreads the split
+                                      # over local chips); "replicated"
+                                      # multi-host falls back to the host
+                                      # path with a warning
     steps_per_dispatch: int = 1       # K: train steps fused into one device
                                       # dispatch via lax.scan (steps.py
                                       # make_fused_train_step); 1 = today's
@@ -306,10 +321,21 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--data_path", default=d.data_path,
                    choices=["host", "resident"],
                    help="input pipeline: host = BatchLoader + prefetch + "
-                        "per-batch H2D (default), resident = whole train "
-                        "split uploaded to device once and batches "
-                        "gathered inside the jitted dispatch (single-host "
-                        "only; zero steady-state host work)")
+                        "per-batch H2D (default), resident = train split "
+                        "uploaded to device once and batches gathered "
+                        "inside the jitted dispatch (zero steady-state "
+                        "host work; multi-host via per-host sharded "
+                        "residency, see --resident_layout)")
+    p.add_argument("--resident_layout", default=d.resident_layout,
+                   choices=["auto", "replicated", "sharded"],
+                   help="placement of the resident split: auto = "
+                        "replicated single-host / per-host sharded on "
+                        "pods; sharded = each process holds only its row "
+                        "shard (~n/process_count per host) and one jitted "
+                        "re-shard per epoch builds the batch-major view "
+                        "(steady-state gathers stay in local HBM); "
+                        "replicated = the r8 whole-split-per-host layout "
+                        "(single-host only)")
     p.add_argument("--steps_per_dispatch", default=d.steps_per_dispatch,
                    type=int,
                    help="K train steps fused into one device dispatch "
@@ -401,6 +427,7 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         supervise=args.supervise, max_restarts=args.max_restarts,
         preempt_sync_every=args.preempt_sync_every,
         data_path=args.data_path,
+        resident_layout=args.resident_layout,
         steps_per_dispatch=args.steps_per_dispatch,
         seq_len=args.seq_len, n_layers=args.n_layers, d_model=args.d_model,
         d_ff=args.d_ff, n_heads=args.n_heads, attention=args.attention,

@@ -9,11 +9,11 @@ from faster_distributed_training_tpu.data.cifar10 import (  # noqa: F401
 from faster_distributed_training_tpu.data.synthetic import (  # noqa: F401
     synthetic_cifar, synthetic_agnews)
 from faster_distributed_training_tpu.data.loader import (  # noqa: F401
-    BatchLoader, PrefetchIterator, shard_for_host, verify_host_shards,
-    verify_host_shards_global)
+    BatchLoader, PrefetchIterator, pod_epoch_order, shard_for_host,
+    verify_host_shards, verify_host_shards_global)
 from faster_distributed_training_tpu.data.augment import (  # noqa: F401
     augment_batch, normalize)
 from faster_distributed_training_tpu.data.device_resident import (  # noqa: F401,E501
-    DeviceResidentData, build_device_resident)
+    DeviceResidentData, ShardedDeviceResidentData, build_device_resident)
 from faster_distributed_training_tpu.data.agnews import (  # noqa: F401
     AGNewsDataset, clean_text)

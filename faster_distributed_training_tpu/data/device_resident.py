@@ -29,26 +29,58 @@ smallest ``seq_buckets`` entry covering the longest sequence, ≤
 ``max_len``) instead of the host path's per-batch bucketing — a single
 compiled program over the epoch, trading pad FLOPs for zero host work.
 
-Multi-host is deliberately unsupported (cli falls back to the host
-path with a warning): residency would have to be per-host sharded —
-each process holding only its shard — before ``process_count > 1``
-runs could use it without replicating the split into every host's HBM
-and re-deriving the per-host slice in-graph (README "Host-free inner
-loop" records this as the open item)."""
+Two layouts (``--resident_layout``):
+
+  * :class:`DeviceResidentData` (``replicated`` — the r8 layout,
+    default single-host): the split replicated over the mesh, every
+    chip gathering its batch shard from a full local copy.  Single-host
+    only by construction.
+  * :class:`ShardedDeviceResidentData` (``sharded`` — default on pods):
+    the ZeRO move applied to data (Rajbhandari et al., 2020): each
+    process uploads ONLY its addressable row-shard of the split (per-
+    host HBM = n/process_count, not n), and once per epoch ONE jitted
+    collective re-shards the split into that epoch's batch-major layout
+    ``[steps, batch, ...]`` — the same ``shard_for_host`` permutation
+    the host ``BatchLoader`` draws, sliced per host and interleaved
+    process-major (``loader.pod_epoch_order``).  After the re-shard the
+    steady-state in-graph "gather" is a ``dynamic_index`` on the
+    UNsharded leading step axis: every device reads only its own HBM,
+    and no batch bytes cross hosts or the PCIe — the Pathways-style
+    off-critical-path property (Barham et al., 2022), paid once per
+    epoch instead of per step."""
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
 from faster_distributed_training_tpu.data.loader import (dataset_len,
+                                                         pod_epoch_order,
                                                          shard_for_host)
 
 
+def _encode_split(data, max_len: int) -> Tuple[Dict[str, np.ndarray],
+                                               bool, int, int]:
+    """(host_arrays, is_text, seq_len, n): the whole split as compact
+    host numpy — uint8 NHWC images + int32 labels, or the text split
+    pre-encoded at ONE fixed bucket length (the bucket covering the
+    split's longest sequence, so every host-path batch embeds into it —
+    content equality modulo trailing padding, pinned by test)."""
+    is_text = hasattr(data, "encode_batch")
+    n = dataset_len(data)
+    if is_text:
+        host = {k: np.asarray(v) for k, v in
+                data.encode_batch(np.arange(n), max_len).items()}
+        return host, True, int(host["tokens"].shape[1]), n
+    x, y = data
+    return {"image": np.asarray(x), "label": np.asarray(y)}, False, 0, n
+
+
 class DeviceResidentData:
-    """The train split as device arrays + per-epoch order uploads.
+    """The train split as device arrays + per-epoch order uploads
+    (the REPLICATED r8 layout — see module docstring).
 
     ``arrays`` is a dict of device arrays with a leading sample axis
     (images: ``image`` uint8 NHWC + ``label`` int32; text: ``tokens``/
@@ -58,13 +90,15 @@ class DeviceResidentData:
     array — ``steps_per_epoch * batch_size`` int32 entries in exactly
     ``BatchLoader.plan()``'s order."""
 
+    batch_major = False
+
     def __init__(self, data, batch_size: int, seed: int = 0,
                  max_len: int = 512, mesh=None, shuffle: bool = True):
         if jax.process_count() > 1:
             raise ValueError(
-                "device-resident datasets are single-host only (per-host "
-                "sharded residency is an open item); use the host data "
-                "path for multi-host runs")
+                "replicated device residency is single-host only; "
+                "multi-host runs use ShardedDeviceResidentData "
+                "(--resident_layout sharded / auto)")
         self.batch_size = int(batch_size)
         self.seed = int(seed)
         self.shuffle = bool(shuffle)
@@ -74,20 +108,7 @@ class DeviceResidentData:
             raise ValueError(
                 f"dataset ({self.n} samples) smaller than one batch "
                 f"({self.batch_size}) — nothing to train on")
-        self.is_text = hasattr(data, "encode_batch")
-        if self.is_text:
-            # one fixed-length encoding of the whole split: the largest
-            # batch-bucketed length any (seed, epoch) schedule could draw
-            # is the bucket covering the split's longest sequence, so
-            # every host-path batch embeds into this shape (content
-            # equality modulo trailing padding — pinned by test)
-            host = {k: np.asarray(v) for k, v in
-                    data.encode_batch(np.arange(self.n), max_len).items()}
-            self.seq_len = int(host["tokens"].shape[1])
-        else:
-            x, y = data
-            host = {"image": np.asarray(x), "label": np.asarray(y)}
-            self.seq_len = 0
+        host, self.is_text, self.seq_len, _n = _encode_split(data, max_len)
         self.mesh = mesh
         self._replicated = None
         if mesh is not None:
@@ -102,6 +123,12 @@ class DeviceResidentData:
             return jax.device_put(arr, self._replicated)
         return jax.device_put(arr)
 
+    def epoch_arrays(self, epoch: int) -> Dict[str, jax.Array]:
+        """The arrays the fused dispatch gathers from this epoch — the
+        static replicated split (the order indirection happens in-graph
+        via ``epoch_order``)."""
+        return self.arrays
+
     def epoch_order(self, epoch: int) -> jax.Array:
         """The epoch's sample order as a device int32 array, truncated to
         whole batches — elementwise equal to concatenating
@@ -113,18 +140,212 @@ class DeviceResidentData:
         return self._put(np.ascontiguousarray(idx.astype(np.int32)))
 
 
-def build_device_resident(cfg, train_ds, mesh=None
-                          ) -> Optional[DeviceResidentData]:
+class ShardedDeviceResidentData:
+    """Per-host sharded residency + per-epoch batch-major re-shard
+    (see module docstring for the design).
+
+    Storage: every leaf is ONE global array whose sample axis is
+    sharded over the mesh's data axes — each process contributes only
+    its contiguous row range (``make_array_from_process_local_data``),
+    so per-host HBM is ``n / process_count`` (+ the epoch view below).
+    Rows are zero-padded up to a multiple of the data-axis device count;
+    pad rows are never referenced (permutation values are < n).
+
+    ``epoch_arrays(epoch)`` runs one jitted re-shard — gather by the
+    epoch's ``pod_epoch_order`` permutation, reshape to
+    ``[steps_per_epoch, batch_size, ...]``, output-sharded
+    ``P(None, data_axes)`` — so batch ``b`` of the view IS global batch
+    ``b`` of the pod's host loaders (bitwise; tests/test_pod_scale.py),
+    already laid out so each device owns exactly its rows of every
+    batch.  The fused dispatch then just ``dynamic_index``es the
+    unsharded leading axis: fully local HBM reads, zero steady-state
+    host or cross-host traffic.  The view is cached per epoch and
+    replaced (freed) at the next epoch boundary — steady-state HBM is
+    ~2·n/process_count per host (canonical shards + current epoch
+    view), vs n per host for the replicated layout.
+
+    ``process_index``/``process_count`` default to the real runtime and
+    exist as the simulation seam the tier-1 tests use (a single process
+    with a multi-device CPU mesh exercises the full storage + re-shard
+    + gather machinery for simulated pod layouts)."""
+
+    batch_major = True
+
+    def __init__(self, data, batch_size: int, seed: int = 0,
+                 max_len: int = 512, mesh=None, shuffle: bool = True,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        if mesh is None:
+            raise ValueError("sharded device residency requires the mesh "
+                             "(its data axes define the row sharding)")
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from faster_distributed_training_tpu.parallel.sharding import (
+            batch_spec)
+
+        self.mesh = mesh
+        self.pc = (jax.process_count() if process_count is None
+                   else int(process_count))
+        self.pi = (jax.process_index() if process_index is None
+                   else int(process_index))
+        self.batch_size = int(batch_size)          # GLOBAL batch
+        if self.batch_size % self.pc:
+            raise ValueError(f"global batch {self.batch_size} not divisible "
+                             f"by {self.pc} processes")
+        self.local_bs = self.batch_size // self.pc
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+        host, self.is_text, self.seq_len, self.n = _encode_split(data,
+                                                                 max_len)
+        # the host loader's algebra: per-host shard of n // pc samples,
+        # truncated to whole local batches
+        self.steps_per_epoch = (self.n // self.pc) // self.local_bs
+        if self.steps_per_epoch < 1:
+            raise ValueError(
+                f"dataset ({self.n} samples / {self.pc} hosts) smaller "
+                f"than one local batch ({self.local_bs}) — nothing to "
+                f"train on")
+        from faster_distributed_training_tpu.parallel.placement import (
+            dp_size)
+        d = max(dp_size(mesh), 1)
+        if self.batch_size % d:
+            raise ValueError(f"global batch {self.batch_size} not divisible "
+                             f"by the mesh's {d} data-axis devices")
+        real_pc = jax.process_count()
+        if d % real_pc:
+            # the per-process contiguous row slice below only lines up
+            # with the row sharding when the data axis spreads evenly
+            # over processes; a tp-heavy mesh (dp_size < process_count
+            # or not a multiple) would silently mis-shard sample rows
+            raise ValueError(
+                f"sharded device residency needs the mesh's data-axis "
+                f"device count ({d}) to be a multiple of the process "
+                f"count ({real_pc}); use --resident_layout replicated "
+                f"(single-host) or the host data path for this mesh")
+        self._n_pad = -(-self.n // d) * d
+        self._row_sharding = NamedSharding(mesh, batch_spec(mesh))
+        self._batch_sharding = NamedSharding(mesh,
+                                             P(None, *batch_spec(mesh)))
+        self._replicated = NamedSharding(mesh, P())
+        self.nbytes = 0          # HOST-LOCAL bytes resident in this
+        self.arrays: Dict[str, jax.Array] = {}   # process's HBM shard
+        # _encode_split's full-split host arrays are an O(n) transient
+        # per host (the text bucket length is a GLOBAL property of the
+        # split, so every host tokenizes everything; n is bounded by
+        # fits-in-one-host's-HBM anyway) — but the padding below is
+        # applied to the LOCAL slice only, so no host ever materializes
+        # a second full-split copy; everything here is freed on return.
+        real_pi = jax.process_index()
+        for k, v in host.items():
+            if real_pc > 1:
+                rows = self._n_pad // real_pc
+                lo, hi = real_pi * rows, (real_pi + 1) * rows
+                local = v[min(lo, self.n):min(hi, self.n)]
+                if hi > self.n:   # this host's slice covers pad rows
+                    local = np.concatenate(
+                        [local, np.zeros((hi - max(lo, self.n),)
+                                         + v.shape[1:], v.dtype)])
+                self.arrays[k] = jax.make_array_from_process_local_data(
+                    self._row_sharding, np.ascontiguousarray(local))
+                self.nbytes += local.nbytes
+            else:
+                if self._n_pad != self.n:
+                    v = np.concatenate(
+                        [v, np.zeros((self._n_pad - self.n,) + v.shape[1:],
+                                     v.dtype)])
+                self.arrays[k] = jax.device_put(np.ascontiguousarray(v),
+                                                self._row_sharding)
+                self.nbytes += v.nbytes
+        self._reshard = None
+        self._epoch_cache: Tuple[Optional[int], Optional[dict],
+                                 Optional[jax.Array]] = (None, None, None)
+
+    def _put_replicated(self, arr: np.ndarray) -> jax.Array:
+        # make_array_from_callback is the multi-host-safe "same host
+        # value everywhere -> one replicated global array" path (plain
+        # device_put cannot target a process-spanning sharding)
+        return jax.make_array_from_callback(
+            arr.shape, self._replicated, lambda idx: arr[idx])
+
+    def epoch_order(self, epoch: int) -> jax.Array:
+        """The epoch's GLOBAL batch stream (pod_epoch_order) as a device
+        int32 array — slicing ``[b*bs:(b+1)*bs]`` is global batch b,
+        bitwise the pod's host-loader batch (pinned by test).  Kept for
+        bookkeeping/step-signature uniformity: after the batch-major
+        re-shard the dispatch itself never gathers through it."""
+        cached_epoch, _view, order = self._epoch_cache
+        if cached_epoch == epoch and order is not None:
+            return order
+        idx = pod_epoch_order(self.n, epoch, self.seed, self.shuffle,
+                              self.pc, self.local_bs)
+        return self._put_replicated(idx)
+
+    def epoch_arrays(self, epoch: int) -> Dict[str, jax.Array]:
+        """This epoch's batch-major view ``[steps, batch, ...]`` — ONE
+        jitted collective re-shard per epoch (the only cross-device
+        data movement of the epoch), cached until the next epoch."""
+        cached_epoch, view, _order = self._epoch_cache
+        if cached_epoch == epoch and view is not None:
+            return view
+        order = self.epoch_order(epoch)
+        # drop the previous epoch's view BEFORE building the new one
+        # (both the cache and the unpacked local): the cache is the only
+        # reference that survives between epochs, so releasing it first
+        # keeps the boundary peak at shards + ONE view (~2·n/pc per
+        # host) instead of shards + old + new (~3×) — on a pod sharded
+        # precisely because n/pc is near the HBM budget, the 3×
+        # transient would OOM at the first epoch turn
+        view = None
+        self._epoch_cache = (None, None, None)
+        if self._reshard is None:
+            steps, bs = self.steps_per_epoch, self.batch_size
+
+            def fn(data, idx):
+                return {k: v[idx].reshape((steps, bs) + v.shape[1:])
+                        for k, v in data.items()}
+
+            self._reshard = jax.jit(
+                fn, out_shardings={k: self._batch_sharding
+                                   for k in self.arrays})
+        view = self._reshard(self.arrays, order)
+        self._epoch_cache = (epoch, view, order)
+        return view
+
+
+def build_device_resident(cfg, train_ds, mesh=None):
     """cfg-gated constructor: None (host path) unless
-    ``cfg.data_path == "resident"`` and the run is single-host."""
+    ``cfg.data_path == "resident"``.
+
+    Layout resolution (``cfg.resident_layout``):
+      * ``auto``       — replicated single-host (the unchanged r8 path),
+                         per-host sharded on pods;
+      * ``replicated`` — force the r8 layout; multi-host falls back to
+                         the HOST path with a warning (a replicated
+                         multi-host upload would put the whole split in
+                         every host's HBM);
+      * ``sharded``    — force per-host sharding (also usable single-
+                         host to spread the split over local chips).
+    """
     if getattr(cfg, "data_path", "host") != "resident":
         return None
-    if jax.process_count() > 1:
+    layout = getattr(cfg, "resident_layout", "auto") or "auto"
+    pc = jax.process_count()
+    if layout == "replicated" and pc > 1:
         import warnings
         warnings.warn(
-            "--data_path resident is single-host only (per-host sharded "
-            "residency is an open item, see README); falling back to the "
-            "host data path", stacklevel=2)
+            "--resident_layout replicated is single-host only (it would "
+            "replicate the whole split into every host's HBM); falling "
+            "back to the host data path — use --resident_layout auto or "
+            "sharded for per-host sharded residency", stacklevel=2)
         return None
+    if layout == "sharded" or (layout == "auto" and pc > 1):
+        if mesh is None:
+            import warnings
+            warnings.warn(
+                "sharded device residency needs a mesh; falling back to "
+                "the host data path", stacklevel=2)
+            return None
+        return ShardedDeviceResidentData(train_ds, cfg.batch_size,
+                                         seed=cfg.seed, max_len=cfg.seq_len,
+                                         mesh=mesh)
     return DeviceResidentData(train_ds, cfg.batch_size, seed=cfg.seed,
                               max_len=cfg.seq_len, mesh=mesh)

@@ -68,6 +68,43 @@ def shard_for_host(n: int, epoch: int, seed: int = 0, shuffle: bool = True,
     return order[pi * per:(pi + 1) * per]
 
 
+def pod_epoch_order(n: int, epoch: int, seed: int = 0, shuffle: bool = True,
+                    process_count: Optional[int] = None,
+                    local_batch_size: int = 1) -> np.ndarray:
+    """The GLOBAL per-epoch batch stream of a ``process_count``-host pod
+    as one flat int32 index array — the pure function the sharded
+    device-resident path gathers from in-graph.
+
+    The host path's contract: host ``pi`` iterates
+    ``shard_for_host(n, epoch, seed)[pi]`` in ``local_batch_size``
+    chunks and ``make_array_from_process_local_data`` concatenates the
+    per-host chunks (process-major) into each global batch.  This
+    function emits exactly that sequence: entry
+    ``b * (pc * lbs) + pi * lbs + j`` is host ``pi``'s ``j``-th sample
+    of global batch ``b`` — so slicing ``[b * bs : (b + 1) * bs]`` off
+    the result reproduces global batch ``b`` bitwise
+    (tests/test_pod_scale.py pins this against ``BatchLoader.plan()``
+    for simulated 2- and 4-process layouts).
+
+    ``process_count=1`` degenerates to the single-host
+    ``shard_for_host(...)[: steps * bs]`` order the r8 resident path
+    uploads — the two paths share one batch-order algebra."""
+    pc = jax.process_count() if process_count is None else int(process_count)
+    lbs = int(local_batch_size)
+    per = n // pc
+    steps = per // lbs
+    if shuffle:
+        order = np.random.default_rng((seed, epoch)).permutation(n)
+    else:
+        order = np.arange(n)
+    # per-host contiguous shards (shard_for_host's slicing), each
+    # truncated to whole local batches, interleaved batch-major
+    shards = order[: per * pc].reshape(pc, per)[:, : steps * lbs]
+    return np.ascontiguousarray(
+        shards.reshape(pc, steps, lbs).transpose(1, 0, 2).reshape(-1)
+        .astype(np.int32))
+
+
 def verify_host_shards(n: int, epoch: int, seed: int = 0,
                        shuffle: bool = True,
                        process_count: Optional[int] = None) -> None:

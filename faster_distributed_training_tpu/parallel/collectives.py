@@ -26,6 +26,48 @@ def all_sum_across_processes(value) -> np.ndarray:
     return np.asarray(gathered).sum(axis=0)
 
 
+def all_gather_across_processes(value) -> np.ndarray:
+    """Stack a host scalar/array from every process along a new leading
+    axis (shape ``[process_count, ...]``), dtype-preserving.  Single-
+    process: the value with the leading axis added — so callers can
+    reason about host agreement (min == max, set size) without a
+    process_count branch.
+
+    Transport is raw uint8: ``jnp.asarray`` would silently downcast
+    float64→float32 / int64→int32 with x64 off (the default), so a
+    counter past 2^31 or a float64 timestamp would corrupt on the
+    multi-host path only — the one the tests can't reach."""
+    arr = np.asarray(value)
+    if jax.process_count() == 1:
+        return arr[None]
+    from jax.experimental import multihost_utils
+    flat = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+    g = np.asarray(multihost_utils.process_allgather(jnp.asarray(flat)))
+    return g.view(arr.dtype).reshape((g.shape[0],) + arr.shape)
+
+
+def _pack_values(metrics: Dict[str, float]):
+    """(sizes, packed): the dict's values raveled into ONE flat float64
+    vector, in key-insertion order (identical on every host — the dict
+    is built by the same code path everywhere).  float64, not float32:
+    counters like bytes-loaded exceed float32's 2^24 exact-integer
+    ceiling routinely; float64 is exact to 2^53."""
+    parts = [np.ravel(np.asarray(metrics[k], np.float64)) for k in metrics]
+    sizes = [p.size for p in parts]
+    packed = (np.concatenate(parts) if parts
+              else np.zeros(0, np.float64))
+    return sizes, packed
+
+
+def _unpack_values(keys, sizes, summed: np.ndarray) -> Dict[str, float]:
+    out, off = {}, 0
+    for k, s in zip(keys, sizes):
+        v = np.asarray(summed[off:off + s])
+        off += s
+        out[k] = float(v[0]) if s == 1 else v
+    return out
+
+
 def all_reduce_metrics(metrics: Dict[str, float]) -> Dict[str, float]:
     """SUM a dict of per-process-LOCAL counters across hosts.
 
@@ -35,7 +77,21 @@ def all_reduce_metrics(metrics: Dict[str, float]) -> Dict[str, float]:
     every process's devices and psums over the sharded batch) — do NOT feed
     those here or multi-host runs inflate every metric by process_count.
     Use only for values each process computes independently on host
-    (e.g. per-host input-pipeline counters, files read, bytes loaded)."""
+    (e.g. per-host input-pipeline counters, files read, bytes loaded).
+
+    One collective for the whole dict: the values are packed into a
+    single float vector, allgathered ONCE, and unpacked — a D-key dict
+    used to issue D ``process_allgather`` rounds, each a full cross-host
+    rendezvous (the packing is what DDP's bucketed all-reduce does to
+    gradients, applied to host counters).  Scalars come back as floats —
+    the same contract as before (counters are float-valued)."""
     if jax.process_count() == 1:
         return dict(metrics)
-    return {k: float(all_sum_across_processes(v)) for k, v in metrics.items()}
+    if not metrics:
+        return {}
+    sizes, packed = _pack_values(metrics)
+    # the gather's uint8 transport keeps the float64 payload exact
+    # (counters above 2^24 would round through a float32 collective);
+    # the sum happens on host after decoding
+    summed = all_gather_across_processes(packed).sum(axis=0)
+    return _unpack_values(list(metrics), sizes, summed)

@@ -49,7 +49,7 @@ class Preempted(Exception):
 from faster_distributed_training_tpu.resilience.goodput import (  # noqa: E402,F401,E501
     GoodputTracker)
 from faster_distributed_training_tpu.resilience.manager import (  # noqa: E402,F401,E501
-    AsyncCheckpointManager)
+    AsyncCheckpointManager, RestoreDivergence)
 from faster_distributed_training_tpu.resilience.preemption import (  # noqa: E402,F401,E501
     PreemptionHandler)
 from faster_distributed_training_tpu.resilience.supervisor import (  # noqa: E402,F401,E501

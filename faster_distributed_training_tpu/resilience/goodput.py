@@ -24,8 +24,8 @@ from typing import Dict, Optional
 _SEGMENTS = ("checkpoint_blocking_s", "emergency_save_s", "restore_s",
              "restart_backoff_s", "rollback_lost_s")
 # event counters
-_COUNTERS = ("saves", "skipped_saves", "save_failures", "restores",
-             "restarts", "preemptions", "steps")
+_COUNTERS = ("saves", "skipped_saves", "save_failures", "shard_writes",
+             "restores", "restarts", "preemptions", "steps")
 
 
 class GoodputTracker:

@@ -21,13 +21,24 @@ checkpoints don't give:
     restore (corrupt/truncated data with an intact marker), so one bad
     write can never wedge recovery.
 
-Multi-host: ``device_get`` can only fetch addressable shards, so with
-``jax.process_count() > 1`` the manager saves SYNCHRONOUSLY through the
-collective orbax path (async multi-host save is a ROADMAP open item),
-and only the STEP cadence is honored — a pure function of the step
-counter, identical on every host, so the collective save can't
-deadlock.  The wall-clock cadence reads per-host clocks that can
-disagree near a threshold and is disabled multi-host (warned).
+Multi-host: ``device_get`` can only fetch addressable shards — so the
+multi-host async path doesn't try to: each process snapshots ONLY its
+addressable shards (``checkpoint.host_shard_snapshot``, replica-0-owned
+for a globally disjoint exact cover) and a background writer per
+process streams them to a per-host shard file; process 0 writes the
+``COMMIT`` marker only after a cross-host completion barrier (every
+host's ``DONE`` marker on the shared checkpoint filesystem) — the
+two-phase commit that keeps a partially-written pod save invisible to
+restore.  Pods therefore get off-critical-path saves exactly like
+single hosts (the r7 sync-collective fallback is gone; ``sync=True``
+emergency saves keep the collective orbax path, whose entry is already
+cross-host-agreed by the preemption bit).  Restore reassembles from the
+per-host shard files and still reads pre-existing single-file orbax
+checkpoints.  Only the STEP cadence is honored multi-host — a pure
+function of the step counter, identical on every host, so every host
+enters the same save; the wall-clock cadence reads per-host clocks that
+can disagree near a threshold (hosts would write shard sets nobody
+commits) and is disabled multi-host (warned).
 """
 
 from __future__ import annotations
@@ -40,10 +51,27 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, List, Optional, Tuple
 
 import jax
+import numpy as np
 
 from faster_distributed_training_tpu.train import checkpoint as ckpt
 
 _STEP_DIR = re.compile(r"^(?P<prefix>.+)_step_(?P<step>\d{9})$")
+
+
+class RestoreDivergence(RuntimeError):
+    """Pod hosts restored DIFFERENT checkpoint steps (one host's
+    fallback walk diverged from its peers') — resuming would train on
+    divergent state; see AsyncCheckpointManager._verify_restore_agreement."""
+
+
+def _local_delete_tree(path: str) -> None:
+    """Default retention deleter: local/NFS recursive rmtree.  Retention
+    calls through the manager's ``delete_fn`` hook so an object-store
+    backend can replace this — GCS checkpoint dirs have no rmtree (prune
+    needs batched object deletes under the prefix, and the atomic
+    COMMIT-marker write itself needs a compose-or-rename equivalent);
+    that backend is a ROADMAP item, the hook is its seam."""
+    shutil.rmtree(path, ignore_errors=True)
 
 
 class AsyncCheckpointManager:
@@ -51,33 +79,56 @@ class AsyncCheckpointManager:
 
     Not thread-safe for concurrent maybe_save callers (the train loop is
     single-threaded); the background worker only touches the host
-    snapshot handed to it."""
+    snapshot handed to it.
+
+    ``process_index``/``process_count`` default to the real runtime and
+    exist as the simulation seam the tier-1 tests use (two managers in
+    one process, complementary ``shard_owner`` functions, one shared
+    directory = a simulated two-host pod save).  ``force_sharded``
+    routes even a single-process manager down the per-host shard-
+    streaming path (bench's ``ckpt_async_sharded`` arm)."""
 
     def __init__(self, directory: str, prefix: str = "ckpt",
                  every_steps: int = 0, every_secs: float = 0.0,
                  keep: int = 3, async_save: bool = True,
-                 goodput=None, log: Callable[[str], None] = print):
+                 goodput=None, log: Callable[[str], None] = print,
+                 delete_fn: Optional[Callable[[str], None]] = None,
+                 force_sharded: bool = False,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 shard_owner: Optional[Callable] = None,
+                 commit_timeout_s: float = 600.0):
         self.directory = os.path.abspath(directory)
         self.prefix = prefix
         self.every_steps = int(every_steps)
         self.every_secs = float(every_secs)
-        if self.every_secs and jax.process_count() > 1:
+        self._pc = (jax.process_count() if process_count is None
+                    else int(process_count))
+        self._pi = (jax.process_index() if process_index is None
+                    else int(process_index))
+        # per-host shard-streaming saves whenever >1 process (the r7
+        # sync-collective fallback is gone), or forced for bench/tests
+        self._sharded = bool(force_sharded) or self._pc > 1
+        self._shard_owner = shard_owner
+        self._commit_timeout_s = float(commit_timeout_s)
+        self._delete = delete_fn or _local_delete_tree
+        if self.every_secs and self._pc > 1:
             # the wall-clock term reads each host's OWN monotonic clock,
-            # so near a threshold hosts can disagree and one would enter
-            # the COLLECTIVE multi-host save alone — a deadlock.  Only
-            # the step term is a pure function every host agrees on.
+            # so near a threshold hosts disagree: with the sharded path
+            # a lone host writes a shard set nobody ever commits (and
+            # the sync emergency path would deadlock its collective).
+            # Only the step term is a pure function every host agrees
+            # on.
             self.every_secs = 0.0
-            if jax.process_index() == 0:
+            if self._pi == 0:
                 log("[ckpt] --checkpoint_every_secs is per-host-clock-"
-                    "nondeterministic and cannot drive the multi-host "
-                    "collective save (hosts could disagree and deadlock); "
+                    "nondeterministic: hosts near a threshold would "
+                    "disagree and write shard sets that never commit; "
                     "disabled — use the step cadence (--checkpoint_every)")
         self.keep = max(int(keep), 1)
-        # async needs a host snapshot; multi-host arrays aren't fully
-        # addressable from one process, so the collective sync path wins
-        self.async_save = bool(async_save) and jax.process_count() == 1
+        self.async_save = bool(async_save)
         self._goodput = goodput
-        self._log = log if jax.process_index() == 0 else (lambda *_: None)
+        self._log = log if self._pi == 0 else (lambda *_: None)
         self._last_save_t = time.monotonic()
         self._last_save_step: Optional[int] = None
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -144,7 +195,7 @@ class AsyncCheckpointManager:
                 "best_acc": float(best_acc)}
         name = self._name(step)
         if not (self.async_save or sync):
-            sync = True      # multi-host / async disabled: collective path
+            sync = True      # async disabled: blocking collective path
         if sync:
             self._drain_inflight()
             t0 = time.monotonic()
@@ -157,6 +208,8 @@ class AsyncCheckpointManager:
                 self._goodput.count("saves")   # committed — the sync
                 # path only returns after the marker is on disk
             return True
+        if self._sharded:
+            return self._save_sharded(state, step, meta, name, segment)
         if self._inflight is not None and not self._inflight.done():
             if self._goodput:
                 self._goodput.count("skipped_saves")
@@ -187,6 +240,53 @@ class AsyncCheckpointManager:
             ckpt.save_pytree_checkpoint, path, snapshot, meta)
         self._record_save(step, blocking, segment)
         return True
+
+    def _save_sharded(self, state, step: int, meta: dict, name: str,
+                      segment: str) -> bool:
+        """The multi-host async path: per-host addressable-shard snapshot
+        (the only blocking piece) + a background shard write per process,
+        two-phase commit through ``checkpoint.write_host_shards`` /
+        ``commit_sharded_checkpoint``.
+
+        Unlike the single-host async path this DRAINS a still-running
+        previous write instead of skipping the tick: the skip decision
+        depends on per-host write timing (NOT a pure function of the
+        step), so one host could skip a tick its peers take and the
+        commit barrier would starve waiting for its shard.  Draining
+        keeps every host's tick set identical; in steady state the
+        previous write is long finished and the drain is free."""
+        t0 = time.monotonic()   # before the drain: a slow-writer stall
+        # is critical-path time and must land in the blocking segment
+        if self._inflight is not None and not self._inflight.done():
+            self._log(f"[ckpt] step {step}: waiting for the previous "
+                      f"sharded save to finish (slow writer) — the tick "
+                      f"is taken on every host to keep the pod's commit "
+                      f"barrier aligned")
+        self._drain_inflight()
+        # blocking part: the drain above + fetching THIS process's owned
+        # shards to host — the next train step donates those buffers
+        blocks = ckpt.host_shard_snapshot(state, self._shard_owner)
+        blocking = time.monotonic() - t0
+        path = os.path.join(self.directory, name)
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="fdt-ckpt")
+        self._inflight_path = path
+        self._skip_logged = False
+        self._inflight = self._pool.submit(
+            self._write_shards_and_commit, path, blocks, meta)
+        self._record_save(step, blocking, segment)
+        return True
+
+    def _write_shards_and_commit(self, path: str, blocks: list,
+                                 meta: dict) -> None:
+        """Background worker body: phase-1 shard write (every host),
+        phase-2 barrier + COMMIT (process 0 only)."""
+        ckpt.write_host_shards(path, self._pi, blocks)
+        if self._pi == 0:
+            ckpt.commit_sharded_checkpoint(
+                path, meta, n_hosts=self._pc,
+                timeout_s=self._commit_timeout_s)
 
     def _record_save(self, step: int, blocking_s: float,
                      segment: str = "checkpoint_blocking_s") -> None:
@@ -221,7 +321,14 @@ class AsyncCheckpointManager:
                       f"continues; the previous checkpoint remains newest")
             return
         if self._goodput:
-            self._goodput.count("saves")   # committed for real
+            if self._sharded and self._pi != 0:
+                # this host only knows its phase-1 shard write landed;
+                # whether process 0's barrier COMMITTED the step is not
+                # observable here — count the honest thing and leave
+                # 'saves' (= committed checkpoints) to process 0
+                self._goodput.count("shard_writes")
+            else:
+                self._goodput.count("saves")   # committed for real
         self._prune()
 
     def _drain_inflight(self) -> None:
@@ -273,27 +380,90 @@ class AsyncCheckpointManager:
         """(restored_state, meta) from the newest checkpoint that BOTH
         carries a commit marker and actually restores — a committed-but-
         corrupt newest (bit rot, torn block device) falls back to the
-        previous valid one with a warning.  None when nothing restores."""
+        previous valid one with a warning.  None when nothing restores.
+        Sharded (per-host shard-file) and single-file orbax checkpoints
+        interoperate: each entry restores through whichever format it
+        was written in, so a pod run resumes from a pre-sharding
+        checkpoint (and vice versa) transparently."""
         self._drain_inflight()
+        result, restored_step, t0 = None, -1, time.monotonic()
         for step, name in reversed(self._entries()):
             path = os.path.join(self.directory, name)
             if not ckpt.is_committed(path):
                 continue
             try:
-                t0 = time.monotonic()
-                restored, _epoch, _best = ckpt.restore_checkpoint(
-                    self.directory, name, state)
+                if ckpt.is_sharded_checkpoint(path):
+                    restored, _epoch, _best = ckpt.restore_sharded_checkpoint(
+                        self.directory, name, state)
+                else:
+                    restored, _epoch, _best = ckpt.restore_checkpoint(
+                        self.directory, name, state)
                 meta = ckpt.read_checkpoint_meta(self.directory, name)
-                if self._goodput:
-                    self._goodput.count("restores")
-                    self._goodput.add("restore_s", time.monotonic() - t0)
-                self._last_save_step = step
-                return restored, meta
+                result, restored_step = (restored, meta), step
+                break
             except Exception as e:
                 self._log(f"[ckpt] checkpoint {name} is committed but "
                           f"failed to restore ({e!r}); falling back to "
                           f"the previous one")
-        return None
+        # Sweep ALL uncommitted residue now, BEFORE the agreement
+        # collective: a crashed sharded save leaves a dir with every
+        # host's DONE marker but no COMMIT, and if it survived to the
+        # re-reached save step the commit barrier would see the stale
+        # markers and COMMIT a mix of two attempts' shard files.
+        # Restore is the one point where deletion is race-free — the
+        # peers are blocked in _gather_restored_steps below until
+        # process 0 (the only deleter) joins, so no host can be
+        # writing.  Uncommitted dirs are never restorable, so this
+        # deletes only disk (and the stale-marker trap).
+        if self._pi == 0:
+            for _s, n in self._entries():
+                p = os.path.join(self.directory, n)
+                if not ckpt.is_committed(p):
+                    self._delete(p)
+        # cross-host agreement AFTER the walk, joined by EVERY host
+        # regardless of its outcome (None restores gather -1): a host
+        # whose walk fell back — or exhausted every entry — must still
+        # meet its peers in the collective, or they would block forever
+        # waiting for it instead of raising
+        self._verify_restore_agreement(self._gather_restored_steps(
+            restored_step))
+        if result is None:
+            return None
+        if self._goodput:
+            self._goodput.count("restores")
+            self._goodput.add("restore_s", time.monotonic() - t0)
+        self._last_save_step = restored_step
+        return result
+
+    @staticmethod
+    def _gather_restored_steps(step: int) -> np.ndarray:
+        """Every REAL host's restored step (−1 = nothing restored),
+        stacked — the collective piece, split from the pure decision
+        below so the tier-1 simulated-pod tests can exercise the
+        decision without multi-process collectives."""
+        if jax.process_count() == 1:
+            return np.asarray([step], np.int32)
+        from faster_distributed_training_tpu.parallel.collectives import (
+            all_gather_across_processes)
+        return all_gather_across_processes(np.asarray(step, np.int32))
+
+    @staticmethod
+    def _verify_restore_agreement(steps: np.ndarray) -> None:
+        """Multi-host: the restore walk runs independently per host, so a
+        host whose shard-file read failed (torn page, transient IO) would
+        silently fall back to an OLDER checkpoint while its peers resume
+        the newest — divergent state with no error.  Fail LOUDLY on
+        disagreement (every host sees the same gathered vector, so all
+        raise together); the r7 collective restore failed loudly too,
+        this keeps that property."""
+        if int(steps.min()) != int(steps.max()):
+            raise RestoreDivergence(
+                f"hosts restored different checkpoint steps "
+                f"{sorted(set(int(s) for s in steps))} (−1 = none) — a "
+                f"per-host shard-read failure made one host fall back "
+                f"while its peers took the newest; refusing to resume "
+                f"divergent (clear or repair the newest checkpoint dir "
+                f"and rerun)")
 
     # -- retention --------------------------------------------------------
 
@@ -301,8 +471,11 @@ class AsyncCheckpointManager:
         """Keep the newest `keep` COMMITTED checkpoints; also sweep
         uncommitted residue older than the newest committed one (a
         half-written dir from a crash — never restorable, only disk).
-        Process 0 only; other hosts see the shared-fs result."""
-        if jax.process_index() != 0:
+        Process 0 only; other hosts see the shared-fs result.  Deletion
+        goes through the ``delete_fn`` hook (default: local rmtree) so
+        an object-store retention backend can plug in — see
+        ``_local_delete_tree`` for the GCS gap this seam exists for."""
+        if self._pi != 0:
             return
         entries = self._entries()
         committed = [(s, n) for s, n in entries if ckpt.is_committed(
@@ -317,5 +490,4 @@ class AsyncCheckpointManager:
                        and os.path.join(self.directory, n)
                        != self._inflight_path]
         for n in doomed:
-            shutil.rmtree(os.path.join(self.directory, n),
-                          ignore_errors=True)
+            self._delete(os.path.join(self.directory, n))

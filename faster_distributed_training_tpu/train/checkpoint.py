@@ -304,6 +304,231 @@ def _fit_or_template(raw_sub: Any, template_sub: Any, label: str) -> Any:
         return template_sub
 
 
+# ---------------------------------------------------------------------------
+# Per-host shard-streaming checkpoints (pod-scale async saves)
+# ---------------------------------------------------------------------------
+# Layout of a sharded checkpoint directory:
+#   <name>/shards/host_<pi>.npz    raw-byte blocks of the shards host pi OWNS
+#   <name>/shards/host_<pi>.json   manifest: leaf key path, index slices,
+#                                  dtype, shape per block (npz stores flat
+#                                  uint8 — numpy cannot serialize bfloat16)
+#   <name>/shards/host_<pi>.DONE   phase-1 marker, written LAST per host
+#   <name>/meta.json + COMMIT      phase 2, process 0 only, after EVERY
+#                                  host's DONE marker exists (a filesystem
+#                                  completion barrier on the shared
+#                                  checkpoint dir — the same shared-fs
+#                                  assumption the collective orbax path
+#                                  already makes)
+# A kill ANYWHERE before COMMIT leaves a directory is_committed() rejects.
+
+_SHARDS = "shards"
+
+
+def _index_to_json(index) -> Optional[list]:
+    """A jax shard ``index`` (tuple of slices) as json: [[start, stop] per
+    dim], null start/stop = the whole dim; None index (a non-jax leaf,
+    saved whole) -> null."""
+    if index is None:
+        return None
+    return [[s.start, s.stop] for s in index]
+
+
+def _json_to_index(spec, shape) -> Tuple[slice, ...]:
+    return tuple(slice(lo if lo is not None else 0,
+                       hi if hi is not None else dim)
+                 for (lo, hi), dim in zip(spec, shape))
+
+
+def host_shard_snapshot(state, owner=None) -> list:
+    """[(leaf_keystr, index, numpy_block)] — THIS process's owned shard
+    blocks of the checkpointable state, fetched to host.  This is the
+    only blocking piece of a sharded async save (the very next train
+    step donates the buffers).
+
+    ``owner(shard) -> bool`` selects which addressable shards this
+    process writes; the default — ``replica_id == 0`` — gives a
+    globally disjoint exact cover (each block of every sharded array is
+    written by exactly one host; replicated leaves by the host holding
+    replica 0).  Non-jax leaves (python/numpy scalars) are saved whole
+    by every host and overlay idempotently at restore."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(_state_pytree(state))
+    blocks = []
+    for path_, leaf in flat:
+        key = jax.tree_util.keystr(path_)
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            blocks.append((key, None, np.asarray(leaf)))
+            continue
+        for sh in shards:
+            if (owner(sh) if owner is not None else sh.replica_id == 0):
+                blocks.append((key, sh.index, np.asarray(sh.data)))
+    return blocks
+
+
+def write_host_shards(path: str, process_index: int, blocks: list) -> None:
+    """Phase 1 of the two-phase sharded save: write this host's blocks
+    (flat raw bytes + manifest), then its DONE marker LAST — the marker's
+    presence implies this host's contribution is durably complete."""
+    d = os.path.join(path, _SHARDS)
+    os.makedirs(d, exist_ok=True)
+    # a DONE marker from a CRASHED earlier attempt at this same step
+    # must not be visible while this attempt's blocks are mid-write —
+    # process 0's commit barrier would take it as proof this host
+    # finished and COMMIT a mix of two attempts' shard files.  Remove
+    # ours first (the systematic guard is the restore-time sweep of
+    # uncommitted dirs in AsyncCheckpointManager.restore_latest; this
+    # covers direct callers of the two-phase primitives too).
+    done = os.path.join(d, f"host_{process_index:05d}.DONE")
+    if os.path.exists(done):
+        os.remove(done)
+    arrays, manifest = {}, []
+    for i, (key, index, arr) in enumerate(blocks):
+        # flat-uint8 VIEW, not a copy (tobytes() would double the
+        # writer's host memory across the full owned-shard set); the
+        # raw-byte npz entry is what lets non-numpy dtypes (bfloat16)
+        # round-trip
+        arr = np.asarray(arr)
+        # record the shape BEFORE ascontiguousarray: it returns ndim>=1,
+        # so a rank-0 leaf (step, loss_scale, opt counters) would land
+        # in the manifest as shape [1] against its rank-0 index and
+        # restore would push a (1,)-block into a 0-d target (a numpy
+        # deprecation headed for a hard error)
+        shape = list(arr.shape)
+        arrays[f"b{i}"] = np.ascontiguousarray(arr).reshape(-1).view(
+            np.uint8)
+        manifest.append({"npz": f"b{i}", "leaf": key,
+                         "index": _index_to_json(index),
+                         "dtype": str(arr.dtype),
+                         "shape": shape})
+    npz_path = os.path.join(d, f"host_{process_index:05d}.npz")
+    tmp = npz_path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, npz_path)
+    _write_json_atomic(os.path.join(d, f"host_{process_index:05d}.json"),
+                       manifest)
+    _write_json_atomic(done, {"blocks": len(blocks)})
+
+
+def commit_sharded_checkpoint(path: str, meta: dict, n_hosts: int,
+                              timeout_s: float = 600.0,
+                              poll_s: float = 0.05) -> None:
+    """Phase 2 (process 0 only): wait until EVERY host's DONE marker is
+    on the shared filesystem — the cross-host completion barrier — then
+    write meta.json and the COMMIT marker, in that order, atomically.
+    Raises TimeoutError (leaving the directory uncommitted, hence
+    invisible to restore) if a host never finishes within
+    ``timeout_s``."""
+    d = os.path.join(path, _SHARDS)
+    want = [os.path.join(d, f"host_{pi:05d}.DONE") for pi in range(n_hosts)]
+    deadline = time.monotonic() + timeout_s
+    while True:
+        missing = [w for w in want if not os.path.exists(w)]
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"sharded-checkpoint commit barrier timed out after "
+                f"{timeout_s:.0f}s: {len(missing)}/{n_hosts} host DONE "
+                f"markers missing under {path} — leaving it uncommitted")
+        time.sleep(poll_s)
+    _write_json_atomic(os.path.join(path, _META), meta)
+    _write_json_atomic(os.path.join(path, _COMMIT),
+                       {"committed_unix_time": round(time.time(), 3),
+                        "sharded_hosts": int(n_hosts)})
+
+
+def is_sharded_checkpoint(path: str) -> bool:
+    """True when `path` is a per-host shard-streaming checkpoint (vs a
+    single-file orbax one) — restore dispatches on this."""
+    return os.path.isdir(os.path.join(path, _SHARDS))
+
+
+def restore_sharded_checkpoint(checkpoint_dir: str, name: str,
+                               state: TrainState
+                               ) -> Tuple[TrainState, int, float]:
+    """Reassemble the full state from every host's shard file and fit it
+    onto the (freshly created) `state` template — the sharded analog of
+    :func:`restore_checkpoint`, same return contract.  Every host reads
+    ALL shard files and materializes each leaf at its full global shape
+    in host numpy — O(total state) host RAM and pc× the necessary fs
+    reads per host.  Fine at this repo's state sizes (MBs; the
+    collective orbax restore reassembles on host too), but a state
+    sharded BECAUSE one host can't hold it needs block filtering by
+    overlap with the template's addressable shards before this scales —
+    ROADMAP records that follow-on.  The reassembled leaves are
+    re-placed per the template's shardings on multi-host runs.  A leaf
+    whose blocks do not tile its template shape exactly raises — the
+    resilience manager's newest-VALID walk then falls back past it."""
+    import glob as _glob
+
+    path = _ckpt_dir(checkpoint_dir, name)
+    d = os.path.join(path, _SHARDS)
+    template = _state_pytree(state)
+    t_flat, treedef = jax.tree_util.tree_flatten(template)
+    t_paths, _ = jax.tree_util.tree_flatten_with_path(template)
+    keys = [jax.tree_util.keystr(p) for p, _v in t_paths]
+    key_to_leaf = dict(zip(keys, t_flat))
+    out = {}      # keystr -> (np array being filled, filled element count)
+    for jf in sorted(_glob.glob(os.path.join(d, "host_*.json"))):
+        with open(jf) as f:
+            manifest = json.load(f)
+        npz = np.load(jf[:-len(".json")] + ".npz")
+        for entry in manifest:
+            key = entry["leaf"]
+            if key not in key_to_leaf:
+                raise ValueError(f"sharded checkpoint leaf {key} not in "
+                                 f"the restore template")
+            block = np.frombuffer(
+                npz[entry["npz"]].tobytes(),
+                np.dtype(entry["dtype"])).reshape(entry["shape"])
+            tv = key_to_leaf[key]
+            if key not in out:
+                dt = tv.dtype if hasattr(tv, "dtype") else \
+                    np.asarray(tv).dtype
+                out[key] = [np.zeros(np.shape(tv), dt), 0]
+            target, filled = out[key]
+            if entry["index"] is None or block.shape == target.shape:
+                target[...] = block.astype(target.dtype, copy=False)
+                out[key][1] = target.size
+            else:
+                slc = _json_to_index(entry["index"], target.shape)
+                target[slc] = block.astype(target.dtype, copy=False)
+                out[key][1] = filled + block.size
+    leaves = []
+    for key, tv in zip(keys, t_flat):
+        if key not in out:
+            raise ValueError(f"sharded checkpoint is missing leaf {key}")
+        target, filled = out[key]
+        if filled < target.size:
+            raise ValueError(
+                f"sharded checkpoint leaf {key} incomplete: {filled} of "
+                f"{target.size} elements covered by the host shard files")
+        leaves.append(_placed_like(tv, target))
+    restored = jax.tree_util.tree_unflatten(treedef, leaves)
+    meta = read_checkpoint_meta(checkpoint_dir, name)
+    new_state = state.replace(
+        step=restored["step"], params=restored["params"],
+        batch_stats=restored["batch_stats"],
+        opt_state=restored["opt_state"],
+        loss_scale=restored["loss_scale"], rng=restored["rng"])
+    return (new_state, int(meta.get("epoch", 0)),
+            float(meta.get("best_acc", 0.0)))
+
+
+def _placed_like(template_leaf, value: np.ndarray):
+    """Multi-host: re-place a reassembled numpy leaf per the template's
+    sharding (each process materializes only its addressable blocks).
+    Single-process restores return numpy — matching the orbax path."""
+    sharding = getattr(template_leaf, "sharding", None)
+    if jax.process_count() > 1 and sharding is not None:
+        return jax.make_array_from_callback(value.shape, sharding,
+                                            lambda idx: value[idx])
+    return value
+
+
 def is_committed(path: str) -> bool:
     """True iff `path` holds a COMPLETE checkpoint.
 

@@ -295,6 +295,10 @@ class Trainer:
         t0 = time.monotonic()
         metrics = None
         res = self.resilience
+        # sharded residency re-shards into this epoch's batch-major view
+        # here (ONE collective per epoch); the replicated layout returns
+        # its static arrays and the order drives the in-graph gather
+        data = resident.epoch_arrays(epoch)
         order = resident.epoch_order(epoch)
         n_steps = resident.steps_per_epoch
         if start_step:
@@ -309,7 +313,7 @@ class Trainer:
         while n < n_steps:
             kk = min(self.k, n_steps - n)
             state, metrics = self._fused_step(kk, resident)(
-                state, resident.arrays, order,
+                state, data, order,
                 jax.numpy.asarray(n, jax.numpy.int32))
             acc.add(metrics)
             n += kk

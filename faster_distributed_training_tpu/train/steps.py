@@ -247,7 +247,15 @@ def make_fused_train_step(cfg: TrainConfig, k: int, state_shardings=None,
         gathered from the resident split *inside* the scan body
         (``order`` is the epoch's index array, ``start`` the dispatch's
         first step-in-epoch), so the steady-state loop moves no batch
-        bytes from the host at all.
+        bytes from the host at all.  A ``resident`` with
+        ``batch_major=True`` (per-host sharded residency,
+        ``data.device_resident.ShardedDeviceResidentData``) hands the
+        dispatch this epoch's ``[steps, batch, ...]`` view instead: the
+        permutation was applied by the once-per-epoch re-shard, so the
+        in-graph "gather" is a ``dynamic_index`` on the UNsharded
+        leading axis — every device reads only its own rows of batch
+        ``start + i`` from local HBM (``order`` is carried for
+        signature uniformity but never indexed through).
 
     k == 1 is valid (one-step scan) but the Trainer keeps the plain
     ``make_train_step`` path for it — the default behavior stays
@@ -272,13 +280,23 @@ def make_fused_train_step(cfg: TrainConfig, k: int, state_shardings=None,
             batch_spec)
         constraint = NamedSharding(mesh, batch_spec(mesh))
 
+    batch_major = getattr(resident, "batch_major", False)
+
     def gather_batch(data: Dict[str, jax.Array], order: jax.Array,
                      step_in_epoch: jax.Array) -> Dict[str, jax.Array]:
-        idx = lax.dynamic_slice_in_dim(order, step_in_epoch * bs, bs)
-        # indices come from a host-built permutation of [0, n) — always
-        # in bounds, so skip jnp.take's clamp/fill index normalization
-        batch = {kk: v.at[idx].get(mode="promise_in_bounds")
-                 for kk, v in data.items()}
+        if batch_major:
+            # order was pre-applied by the per-epoch re-shard: just
+            # index the unsharded leading step axis (local-HBM reads)
+            batch = {kk: lax.dynamic_index_in_dim(v, step_in_epoch, 0,
+                                                  keepdims=False)
+                     for kk, v in data.items()}
+        else:
+            idx = lax.dynamic_slice_in_dim(order, step_in_epoch * bs, bs)
+            # indices come from a host-built permutation of [0, n) —
+            # always in bounds, so skip jnp.take's clamp/fill index
+            # normalization
+            batch = {kk: v.at[idx].get(mode="promise_in_bounds")
+                     for kk, v in data.items()}
         if constraint is not None:
             batch = {kk: lax.with_sharding_constraint(v, constraint)
                      for kk, v in batch.items()}
