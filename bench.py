@@ -486,6 +486,48 @@ def timed_checkpoint_overhead(mode: str, bs: int, steps: int) -> dict:
     return out
 
 
+def timed_restart_mttr() -> dict:
+    """Restart-MTTR arm (r10 pod-coordination PR): a small supervised
+    run with a deterministic injected crash, reporting the goodput
+    tracker's mean time-to-recover per restart — detection latency +
+    supervisor backoff + checkpoint restore (resilience/goodput.py).
+    Single-host own-crash recovery: detection is ~0 and the number is
+    dominated by backoff + restore — the recovery FLOOR a pod-scale
+    incident adds peer-detection latency (bounded by
+    --peer_timeout_s / the FAIL-marker poll cadence) on top of.  The
+    training itself is tiny by design: MTTR measures the recovery
+    machinery, not the workload."""
+    import shutil
+    import tempfile
+
+    from faster_distributed_training_tpu.cli import run_training
+    from faster_distributed_training_tpu.config import TrainConfig
+    from faster_distributed_training_tpu.resilience import (
+        faults as faults_mod)
+
+    d = tempfile.mkdtemp(prefix="fdt_bench_mttr_")
+    die_at = int(os.environ.get("FDT_BENCH_MTTR_DIE_AT", "6"))
+    os.environ[faults_mod.ENV_DIE] = str(die_at)
+    cfg = TrainConfig(model="transformer", dataset="synthetic",
+                      num_classes=4, batch_size=8, seq_len=16, n_layers=1,
+                      d_model=16, d_ff=32, n_heads=2, epochs=2,
+                      subset_stride=64, optimizer="sgd", precision="fp32",
+                      plot=False, workers=0, log_every=0, donate=False,
+                      checkpoint_dir=d, checkpoint_every=4, supervise=True)
+    try:
+        out = run_training(cfg, log=lambda *_: None)
+    finally:
+        os.environ.pop(faults_mod.ENV_DIE, None)
+        shutil.rmtree(d, ignore_errors=True)
+    return {"restart_mttr_s": float(out.get("goodput_restart_mttr_s", 0.0)),
+            "restore_s": round(float(out.get("goodput_restore_s", 0.0)), 3),
+            "backoff_s": round(
+                float(out.get("goodput_restart_backoff_s", 0.0)), 3),
+            "detect_s": round(float(out.get("goodput_detect_s", 0.0)), 3),
+            "restarts": int(out.get("goodput_restarts", 0)),
+            "die_at": die_at}
+
+
 def timed_fused(model: str, k: int, bs: int, seq: int, steps: int) -> dict:
     """K-step fused dispatch arm (r8 tentpole): the full train program on
     DEVICE-RESIDENT synthetic data, K steps per dispatch
@@ -1004,6 +1046,11 @@ def main() -> None:
         print(json.dumps(timed_checkpoint_overhead(
             child[len("ckpt_"):], cbs, csteps)))
         return
+    if child == "restart_mttr":
+        # r10 resilience arm: one supervised crash-and-recover cycle,
+        # MTTR decomposition from the goodput tracker
+        print(json.dumps(timed_restart_mttr()))
+        return
     if child.startswith("kdis_"):
         # r8 fused-dispatch ladder: one (model, K) cell per child
         _, m, kk = child.split("_")
@@ -1296,6 +1343,18 @@ def main() -> None:
                     record[f"ckpt_{m}_amortized_overhead_pct"] = round(
                         (ck[m]["mean_step_ms"] - ck["off"]["mean_step_ms"])
                         / ck["off"]["mean_step_ms"] * 100.0, 2)
+            # Restart MTTR (r10 pod-coordination arm): the wall cost of
+            # ONE supervised crash-and-recover cycle — detect + backoff
+            # + restore per restart, the recovery floor a pod incident
+            # adds peer-detection latency on top of (see
+            # timed_restart_mttr; components published beside the
+            # headline so a regression names its segment).
+            mt = _run_child("restart_mttr")
+            if mt and mt.get("restarts"):
+                record["restart_mttr_s"] = mt["restart_mttr_s"]
+                record["restart_mttr_restore_s"] = mt["restore_s"]
+                record["restart_mttr_backoff_s"] = mt["backoff_s"]
+                record["restart_mttr_detect_s"] = mt["detect_s"]
         # K-step fused dispatch ladder + data-path A/B (r8 tentpole):
         # per-step time at K in {1, 4, 16} on the device-resident path
         # for both workloads, and the host-vs-resident input-pipeline
@@ -1421,7 +1480,7 @@ def _essentials(record: dict) -> dict:
             "transformer_eval_ex_per_sec_bs256_seq256",
             "tricks_speedup_x", "ckpt_async_overhead_pct",
             "ckpt_async_amortized_overhead_pct",
-            "ckpt_async_sharded_overhead_pct",
+            "ckpt_async_sharded_overhead_pct", "restart_mttr_s",
             "transformer_bs256_seq256_k1_step_ms",
             "transformer_bs256_seq256_k4_step_ms",
             "transformer_bs256_seq256_k16_step_ms",

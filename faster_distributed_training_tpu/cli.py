@@ -724,8 +724,14 @@ def run_training(cfg: TrainConfig,
         with trace_profile("./profile" if cfg.profile else None):
             try:
                 if res is not None and cfg.supervise:
+                    # coordinator (pods / --step_timeout_s): every attempt
+                    # enters the shared-fs generation rendezvous and every
+                    # failure is published as a FAIL marker BEFORE the
+                    # backoff, so all hosts of the pod restart together
+                    # (resilience/coordinator.py)
                     sup = Supervisor(max_restarts=cfg.max_restarts,
-                                     goodput=res.goodput, log=log)
+                                     goodput=res.goodput, log=log,
+                                     coordinator=res.coordinator)
                     state = sup.run(attempt,
                                     progress=lambda: trainer.global_step)
                 else:

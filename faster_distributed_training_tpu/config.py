@@ -194,7 +194,27 @@ class TrainConfig:
     preempt_sync_every: int = 8       # steps between cross-host preemption
                                       # agreement collectives (multi-host
                                       # only; bounds SIGTERM-to-save latency
-                                      # vs per-step allgather cost)
+                                      # vs per-step allgather cost).  The
+                                      # pod coordinator polls peer FAIL
+                                      # markers at the same cadence
+    peer_timeout_s: float = 60.0      # pod health watchdog: a peer whose
+                                      # heartbeat file is older than this is
+                                      # presumed dead and the pod restarts
+                                      # together (resilience/coordinator.py;
+                                      # active with --supervise on a pod)
+    step_timeout_s: float = 0.0       # local step watchdog (requires
+                                      # --supervise — warned otherwise): no
+                                      # completed
+                                      # dispatch for this many seconds means
+                                      # this host is wedged (hung device
+                                      # program / collective blocked on a
+                                      # dead peer) — the watchdog thread
+                                      # writes its FAIL marker and hard-
+                                      # aborts so the pod converges on a
+                                      # restart.  0 = off (default: it must
+                                      # exceed the worst-case dispatch
+                                      # (re)compile, which only the operator
+                                      # knows)
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
@@ -315,7 +335,19 @@ def build_parser(prog: str = "fdt",
                    type=int,
                    help="steps between cross-host preemption-agreement "
                         "collectives (multi-host; lower = faster SIGTERM-"
-                        "to-emergency-save, higher = less sync overhead)")
+                        "to-emergency-save, higher = less sync overhead); "
+                        "the pod coordinator polls peer failure markers at "
+                        "the same cadence")
+    p.add_argument("--peer_timeout_s", default=d.peer_timeout_s, type=float,
+                   help="pod health watchdog: a peer heartbeat older than "
+                        "this many seconds is a failed host and the pod "
+                        "restarts together (with --supervise on a pod)")
+    p.add_argument("--step_timeout_s", default=d.step_timeout_s, type=float,
+                   help="local step watchdog (requires --supervise): no "
+                        "completed dispatch for this many seconds => write "
+                        "a FAIL marker and hard-abort so the pod converges "
+                        "on a restart (0 = off; must exceed the worst-case "
+                        "dispatch (re)compile time)")
     p.add_argument("--debug", action="store_true",
                    help="per-epoch NGD Fisher invariant self-tests")
     p.add_argument("--data_path", default=d.data_path,
@@ -426,6 +458,8 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         checkpoint_async=not args.sync_checkpoint,
         supervise=args.supervise, max_restarts=args.max_restarts,
         preempt_sync_every=args.preempt_sync_every,
+        peer_timeout_s=args.peer_timeout_s,
+        step_timeout_s=args.step_timeout_s,
         data_path=args.data_path,
         resident_layout=args.resident_layout,
         steps_per_dispatch=args.steps_per_dispatch,

@@ -20,8 +20,13 @@ layered on machinery the repo already has:
     injection (die/SIGTERM at step N, data-iterator raise, checkpoint
     corruption) that the CPU test suite drives;
   * ``goodput``     — :class:`GoodputTracker`: productive time vs.
-    checkpoint/restore/restart badput, surfaced per epoch through
-    ``train/metrics.py`` and benched by the ``ckpt_*`` bench.py arms.
+    checkpoint/restore/restart badput (and restart MTTR), surfaced per
+    epoch through ``train/metrics.py`` and benched by the ``ckpt_*`` /
+    ``restart_mttr_s`` bench.py arms;
+  * ``coordinator`` — :class:`PodCoordinator` (r10): pod-coordinated
+    restarts (shared-fs generation rendezvous so every host restarts
+    into the same generation) + the cluster health watchdog (per-host
+    heartbeats, peer-staleness detection, local step-hang escalation).
 
 ``Resilience`` bundles the pieces for the Trainer; ``build_resilience``
 constructs the bundle from a TrainConfig (cli.run_training's path).
@@ -30,6 +35,7 @@ constructs the bundle from a TrainConfig (cli.run_training's path).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable, Optional
 
 
@@ -48,6 +54,8 @@ class Preempted(Exception):
 
 from faster_distributed_training_tpu.resilience.goodput import (  # noqa: E402,F401,E501
     GoodputTracker)
+from faster_distributed_training_tpu.resilience.coordinator import (  # noqa: E402,F401,E501
+    PeerFailure, PodCoordinator, StepTimeout, pod_identity)
 from faster_distributed_training_tpu.resilience.manager import (  # noqa: E402,F401,E501
     AsyncCheckpointManager, RestoreDivergence)
 from faster_distributed_training_tpu.resilience.preemption import (  # noqa: E402,F401,E501
@@ -61,18 +69,31 @@ from faster_distributed_training_tpu.resilience.faults import (  # noqa: E402,F4
 @dataclasses.dataclass
 class Resilience:
     """The bundle the Trainer consumes (train/loop.py).  Any piece may be
-    None; ``goodput`` always exists so accounting never needs guards."""
+    None; ``goodput`` always exists so accounting never needs guards.
+    ``pod_index``/``pod_count``/``pod_simulated`` carry the pod identity
+    the bundle was built for (the env seam or the real runtime) so the
+    loop can gate per-pod-process behavior (e.g. only simulated-pod
+    host 0 writes the shared epoch checkpoint — each simulated process
+    computes the identical full state, and concurrent orbax writers on
+    one path would race; a REAL pod's orbax save is collective and must
+    be entered by every host)."""
 
     manager: Optional[AsyncCheckpointManager] = None
     preemption: Optional[PreemptionHandler] = None
     faults: Optional[FaultPlan] = None
     goodput: GoodputTracker = dataclasses.field(default_factory=GoodputTracker)
+    coordinator: Optional[PodCoordinator] = None
+    pod_index: int = 0
+    pod_count: int = 1
+    pod_simulated: bool = False
 
     def close(self) -> None:
         if self.manager is not None:
             self.manager.close()
         if self.preemption is not None:
             self.preemption.uninstall()
+        if self.coordinator is not None:
+            self.coordinator.close()
 
 
 def build_resilience(cfg, log: Callable[[str], None] = print
@@ -83,14 +104,60 @@ def build_resilience(cfg, log: Callable[[str], None] = print
     Enabled by any of: --checkpoint_every / --checkpoint_every_secs
     (step-cadence manager + preemption handler), --supervise, or an
     armed FDT_FAULT_* plan (fault injection needs the hooks even when
-    checkpointing is off)."""
-    faults = FaultPlan.from_env()
+    checkpointing is off).
+
+    Pod coordination (r10): with --supervise on a pod (real multi-host,
+    or the FDT_POD_INDEX/FDT_POD_COUNT simulation seam) — or whenever
+    --step_timeout_s arms the local hang watchdog — the bundle grows a
+    :class:`PodCoordinator` under ``<checkpoint_dir>/_pod`` and the
+    supervisor/loop drive the coordinated-restart protocol through it.
+    In the fs-SIMULATED pod the manager also takes the simulated
+    identity (host 0 owns the replica-0 shards, peers own none — every
+    simulated process computes the identical full state) and the
+    coordinator's marker-file allgather replaces the jax collective in
+    the restore step-agreement."""
+    pi, pc, simulated = pod_identity()
+    faults = FaultPlan.from_env(process_index=pi)
     cadence = bool(cfg.checkpoint_every or cfg.checkpoint_every_secs)
+    step_timeout = float(getattr(cfg, "step_timeout_s", 0.0) or 0.0)
+    if step_timeout > 0 and not cfg.supervise:
+        # BEFORE the enablement gate: --step_timeout_s as the ONLY
+        # resilience flag must still warn, not silently no-op
+        log("[resilience] WARNING: --step_timeout_s has no effect without "
+            "--supervise — the hang watchdog lives on the pod coordinator, "
+            "which only the supervised path builds; a wedged dispatch "
+            "will block forever")
     if not (cadence or cfg.supervise or faults is not None):
         return None
     goodput = GoodputTracker()
+    coordinator = None
+    if cfg.supervise and (pc > 1 or step_timeout > 0):
+        coordinator = PodCoordinator(
+            os.path.join(cfg.checkpoint_dir, "_pod"),
+            process_index=pi, process_count=pc,
+            sync_every=cfg.preempt_sync_every,
+            peer_timeout_s=float(getattr(cfg, "peer_timeout_s", 60.0)),
+            step_timeout_s=step_timeout,
+            goodput=goodput, log=log)
     manager = None
     if cadence:
+        sim_kw = {}
+        if simulated and pc > 1:
+            # simulated pod: complementary shard owners (the r9 test
+            # seam — host 0 writes the full replica-0 cover, peers write
+            # empty shard sets whose DONE markers the commit barrier
+            # still requires) + the fs-based restore step agreement
+            sim_kw = dict(
+                process_index=pi, process_count=pc,
+                shard_owner=((lambda sh: sh.replica_id == 0) if pi == 0
+                             else (lambda sh: False)),
+                # a host missing the commit barrier longer than the peer
+                # timeout is presumed dead — keep the two timescales tied
+                commit_timeout_s=max(
+                    2.0 * float(getattr(cfg, "peer_timeout_s", 60.0)),
+                    10.0))
+            if coordinator is not None:
+                sim_kw["step_gather_fn"] = coordinator.gather_restored_step
         manager = AsyncCheckpointManager(
             cfg.checkpoint_dir,
             # mirror the epoch-checkpoint naming (loop.py ckpt_name) so
@@ -102,8 +169,10 @@ def build_resilience(cfg, log: Callable[[str], None] = print
             every_secs=cfg.checkpoint_every_secs,
             keep=cfg.checkpoint_keep,
             async_save=cfg.checkpoint_async,
-            goodput=goodput, log=log)
+            goodput=goodput, log=log, **sim_kw)
     preemption = PreemptionHandler(sync_every=cfg.preempt_sync_every,
                                    log=log).install()
     return Resilience(manager=manager, preemption=preemption,
-                      faults=faults, goodput=goodput)
+                      faults=faults, goodput=goodput,
+                      coordinator=coordinator, pod_index=pi, pod_count=pc,
+                      pod_simulated=simulated)

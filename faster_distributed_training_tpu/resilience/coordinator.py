@@ -1,0 +1,605 @@
+"""Pod-coordinated restart protocol + cluster health watchdog.
+
+The r7 supervisor restarts *the process it lives in*.  On a multi-host
+pod that is not enough: a crash on one host leaves its peers blocked
+forever inside the next collective — the dominant badput source the
+large-scale systems literature identifies (MegaScale's hang/partial-
+failure taxonomy, Pathways' single-controller failure handling): no
+process INSIDE a blocked collective can observe that a peer died.  Two
+cooperating pieces close the gap, both living on the shared checkpoint
+filesystem (the same marker-file idiom as the r9 two-phase commit — the
+one medium every host can reach without a working collective):
+
+  * **Restart coordination protocol** (:class:`PodCoordinator`): a
+    monotonically increasing *generation* directory
+    ``_pod/gen_<g>/``.  A host that fails locally writes ``FAIL_<pi>``
+    into the current generation; every host polls the failure markers at
+    the preemption-sync cadence, abandons the attempt
+    (:class:`PeerFailure`) and re-enters ``Supervisor.run`` — whose next
+    attempt computes the SAME next generation (1 + the newest generation
+    carrying a FAIL marker) on every host, so the pod converges on one
+    restart.  Each attempt then restores through ``restore_latest``'s
+    cross-host step-agreement, so all hosts provably resume from the
+    same checkpoint step; the (seed, epoch, step)-pure batch order means
+    the data iterators re-agree on position for free (pinned by
+    tests/test_pod_restart.py, not assumed).
+
+  * **Health watchdog**: a per-host heartbeat thread touches
+    ``HB_<pi>`` with the current step every ``hb_interval_s`` seconds;
+    :meth:`check` flags a peer whose heartbeat is stale past
+    ``peer_timeout_s`` (the host died without writing FAIL — SIGKILL,
+    kernel panic, machine loss).  The same thread watches the LOCAL
+    step clock: a dispatch exceeding ``step_timeout_s`` means this
+    host's main thread is wedged (hung device program, a collective
+    blocked on a dead peer) — the watchdog is the only thing still able
+    to act, so it escalates by durably writing its own ``FAIL`` marker
+    (kind="hang") and hard-aborting the process; the peers observe the
+    marker (or the heartbeat going stale) and the pod converges on a
+    restart instead of deadlocking.
+
+Detection/restore latencies feed the goodput tracker (``detect_s``,
+``restore_s``, ``restart_backoff_s`` → ``restart_mttr_s``) so MTTR is a
+first-class metric beside goodput_pct.
+
+Simulation seam (mirrors the r9 manager seam): ``process_index`` /
+``process_count`` default to the real jax runtime but can be overridden
+— two coordinators sharing one directory ARE a simulated two-host pod,
+and :func:`pod_identity` reads ``FDT_POD_INDEX``/``FDT_POD_COUNT`` so
+the pod_restart_smoke script can run a REAL two-process simulated pod
+(coordination cross-process through the fs; jax stays single-process
+per host, so each host computes the identical full state).  In that
+fs-simulated mode :meth:`gather_restored_step` supplies the restore
+step-agreement barrier that real pods get from the jax collective.
+
+Clock caveat: marker timestamps are host wall clocks; the detect_s
+latency derived from a PEER's marker is exact in the single-machine
+simulations and subject to NTP skew across real hosts (seconds — noise
+against multi-second detection cadences, documented rather than
+hidden)."""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import signal
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+ENV_POD_INDEX = "FDT_POD_INDEX"
+ENV_POD_COUNT = "FDT_POD_COUNT"
+
+_GEN_DIR = re.compile(r"^gen_(?P<gen>\d{6})$")
+# strict: the atomic writer stages `FAIL_<pi>.tmp<pid>` beside the real
+# marker — listing-based discovery must never parse those as markers
+_FAIL = re.compile(r"^FAIL_(?P<pi>\d{5})$")
+
+
+class PeerFailure(RuntimeError):
+    """A peer host failed (FAIL marker observed) or went heartbeat-stale
+    — this attempt is abandoned so the whole pod re-enters the
+    supervisor together.  RESTARTABLE: the supervisor retries it like
+    any crash (the next attempt converges on the same new generation on
+    every host)."""
+
+
+class StepTimeout(RuntimeError):
+    """This host's own step made no progress for ``step_timeout_s`` and
+    the watchdog escalated (its FAIL marker is already on the shared
+    fs).  Raised by the main-thread poll when the hang RELEASES (test
+    harnesses); in production the escalation hard-aborts the process
+    before this can be raised — the platform's re-launch plays the
+    supervisor's role."""
+
+
+def pod_identity(env=os.environ) -> Tuple[int, int, bool]:
+    """(process_index, process_count, simulated).
+
+    ``FDT_POD_INDEX``/``FDT_POD_COUNT`` override the jax runtime — the
+    simulation seam the pod_restart_smoke script and the tier-1 tests
+    use (jax stays single-process; only the RESTART coordination and
+    the checkpoint two-phase commit run cross-process).  Without them,
+    the real runtime."""
+    if env.get(ENV_POD_COUNT):
+        return (int(env.get(ENV_POD_INDEX, "0")), int(env[ENV_POD_COUNT]),
+                True)
+    import jax
+    return jax.process_index(), jax.process_count(), False
+
+
+def _write_json_atomic(path: str, obj) -> None:
+    # local copy of checkpoint._write_json_atomic (tmp + replace + fsync)
+    # so the watchdog thread can write markers without importing the
+    # orbax-heavy checkpoint module from a non-main thread mid-crash.
+    # The tmp name carries the THREAD ident too: the heartbeat is
+    # written from both the watchdog thread (every hb_interval_s) and
+    # the main thread (begin_attempt) — a pid-only tmp path would let
+    # one thread's os.replace consume the other's staged file and turn
+    # a benign overlap into FileNotFoundError
+    import json
+    tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    import json
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class PodCoordinator:
+    """Owns ``<directory>/gen_<g>/`` and this host's markers in it.
+
+    Lifecycle: the supervisor calls :meth:`begin_attempt` before every
+    attempt (starts the heartbeat/watchdog thread on first use) and
+    :meth:`record_failure` when one dies; the train loop calls
+    :meth:`check` once per dispatch (cadence-gated internally) and wraps
+    each epoch in :meth:`watch_steps` so the step watchdog only runs
+    while dispatches are actually expected to complete (never during
+    eval or restore — heartbeats continue regardless, proving liveness
+    to the peers).  ``abort_fn`` is the escalation seam: the default
+    SIGKILLs the process (the main thread may be wedged in C code where
+    nothing softer is guaranteed to run); tests inject a releasing
+    hook."""
+
+    def __init__(self, directory: str, process_index: Optional[int] = None,
+                 process_count: Optional[int] = None, sync_every: int = 8,
+                 peer_timeout_s: float = 60.0, step_timeout_s: float = 0.0,
+                 hb_interval_s: float = 2.0, gather_timeout_s: float = 120.0,
+                 goodput=None, log: Callable[[str], None] = print,
+                 abort_fn: Optional[Callable[[str], None]] = None):
+        if process_index is None or process_count is None:
+            pi, pc, _sim = pod_identity()
+            process_index = pi if process_index is None else process_index
+            process_count = pc if process_count is None else process_count
+        self.directory = os.path.abspath(directory)
+        self.pi = int(process_index)
+        self.pc = int(process_count)
+        self.sync_every = max(int(sync_every), 1)
+        self.peer_timeout_s = float(peer_timeout_s)
+        self.step_timeout_s = float(step_timeout_s)
+        self.hb_interval_s = float(hb_interval_s)
+        self.gather_timeout_s = float(gather_timeout_s)
+        self._goodput = goodput
+        self._log = log
+        self._abort = abort_fn or self._default_abort
+        # EXIT markers older than this coordinator are a PREVIOUS run's
+        # completions (the same checkpoint_dir reused to train further)
+        # and must not poison this run — see _exited_peers
+        self._created_t = time.time()
+        self._gen: Optional[int] = None
+        self._gen_dir: Optional[str] = None
+        self._attempt_wall_t = time.time()
+        self._last_polled = -1
+        # shared with the watchdog thread (plain attrs: CPython atomic
+        # loads/stores; the thread only READS them)
+        self._step = 0
+        self._progress_t = time.monotonic()
+        self._watching = False
+        self._escalated = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- marker paths ------------------------------------------------------
+
+    def _gen_path(self, gen: int) -> str:
+        return os.path.join(self.directory, f"gen_{gen:06d}")
+
+    def _marker(self, kind: str, pi: int, gen_dir: Optional[str] = None
+                ) -> str:
+        return os.path.join(gen_dir or self._require_gen(), f"{kind}_{pi:05d}")
+
+    def _require_gen(self) -> str:
+        if self._gen_dir is None:
+            # a caller (direct restore, record_failure before any
+            # attempt) outran begin_attempt: join the protocol at the
+            # generation begin_attempt would compute
+            self.begin_attempt()
+        return self._gen_dir
+
+    def _generations(self) -> List[Tuple[int, str]]:
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            m = _GEN_DIR.match(n)
+            if m:
+                out.append((int(m.group("gen")),
+                            os.path.join(self.directory, n)))
+        return sorted(out)
+
+    def _failures(self, gen_dir: str) -> Dict[int, dict]:
+        out = {}
+        try:
+            names = os.listdir(gen_dir)
+        except OSError:
+            return out
+        for n in names:
+            m = _FAIL.match(n)
+            if m:
+                out[int(m.group("pi"))] = _read_json(
+                    os.path.join(gen_dir, n)) or {}
+        return out
+
+    # -- restart coordination protocol -------------------------------------
+
+    def begin_attempt(self) -> int:
+        """Enter the pod's current generation: 1 + the newest generation
+        holding any FAIL marker (0 on a clean directory).  Every host
+        computes this from the same shared-fs state, so hosts that
+        restarted for DIFFERENT reasons (own crash vs observed peer
+        failure) still converge on one generation — and a fresh process
+        launched into an old incident's directory joins at the incident's
+        next generation rather than rewinding the counter."""
+        g = 0
+        for gen, d in self._generations():
+            if self._failures(d):
+                g = gen + 1
+        if self._gen is not None:
+            if g > self._gen and self._goodput is not None:
+                self._goodput.count("restart_generations", g - self._gen)
+            g = max(g, self._gen)
+        changed = g != self._gen
+        self._gen = g
+        self._gen_dir = self._gen_path(g)
+        os.makedirs(self._gen_dir, exist_ok=True)
+        try:
+            # an attempting host is by definition not done: clear our own
+            # completion marker (a previous run's residue when the same
+            # checkpoint_dir is relaunched; peers also time-scope what
+            # they honor — _exited_peers)
+            os.remove(os.path.join(self.directory, f"EXIT_{self.pi:05d}"))
+        except OSError:
+            pass
+        self._attempt_wall_t = time.time()
+        self._last_polled = -1
+        self._escalated = False
+        self._progress_t = time.monotonic()
+        self._write_heartbeat()
+        if changed:
+            self._log(f"[pod] host {self.pi}/{self.pc} entering "
+                      f"generation {g}")
+        self._ensure_thread()
+        self._prune_generations()
+        return g
+
+    def record_failure(self, exc: BaseException,
+                       step: Optional[int] = None) -> None:
+        """Durably publish this host's failure to the pod (atomic marker
+        write).  Best-effort: a failing shared fs must not mask the
+        original exception."""
+        kind = ("hang" if isinstance(exc, StepTimeout)
+                else "peer" if isinstance(exc, PeerFailure) else "crash")
+        try:
+            self._write_fail(kind, f"{type(exc).__name__}: {exc}", step)
+        except OSError as e:
+            self._log(f"[pod] host {self.pi}: could not write FAIL marker "
+                      f"({e!r}) — peers will detect via heartbeat staleness")
+
+    def record_completion(self, step: Optional[int] = None) -> None:
+        """Durably mark this host's run COMPLETE (``EXIT_<pi>`` at the
+        coordination-directory ROOT, outside any generation, so it
+        survives generation pruning).  Written by the supervisor on a
+        successful run.  An exited peer is success, not failure: the
+        staleness monitor ignores it (hosts finish at slightly
+        different times — its heartbeat going quiet must not restart
+        the stragglers), but the restore-agreement barrier fails FAST
+        on it — a host restarting after a peer already finished can
+        never rejoin the pod, and learning that immediately beats
+        waiting out gather_timeout_s per attempt."""
+        try:
+            _write_json_atomic(
+                os.path.join(self.directory, f"EXIT_{self.pi:05d}"),
+                {"step": self._step if step is None else int(step),
+                 "unix_time": round(time.time(), 3)})
+        except OSError as e:
+            self._log(f"[pod] host {self.pi}: could not write EXIT marker "
+                      f"({e!r}) — a later-restarting peer will wait out "
+                      f"its restore barrier instead of failing fast")
+
+    def _exited_peers(self) -> List[int]:
+        """Peers that completed THIS run: EXIT markers newer than this
+        coordinator's creation.  An older marker is a PREVIOUS run's
+        completion (the same checkpoint_dir relaunched to train
+        further) — honoring it would permanently disable staleness
+        detection for that peer and fail fresh restore barriers with
+        "pod already finished", so it is ignored (and each host deletes
+        its own stale marker in begin_attempt).  The in-process
+        supervisor restart — the path the fail-fast exists for — keeps
+        its coordinator across attempts, so a peer completing mid-run
+        always postdates it.  Cross-host NTP skew (seconds) is noise
+        against the run-length gap that separates the two cases."""
+        out = []
+        for pi in range(self.pc):
+            if pi == self.pi:
+                continue
+            got = _read_json(os.path.join(self.directory, f"EXIT_{pi:05d}"))
+            if got is not None and got.get("unix_time", 0.0) > self._created_t:
+                out.append(pi)
+        return out
+
+    def _write_fail(self, kind: str, reason: str,
+                    step: Optional[int] = None) -> None:
+        _write_json_atomic(
+            self._marker("FAIL", self.pi),
+            {"kind": kind, "reason": reason[:500],
+             "step": self._step if step is None else int(step),
+             "unix_time": round(time.time(), 3)})
+
+    def check(self, step: int) -> None:
+        """Main-thread poll, called once per dispatch; raises
+        :class:`PeerFailure` / :class:`StepTimeout` when the attempt
+        must be abandoned.  Cadence-gated with the same boundary-
+        crossing algebra as the preemption agreement bit (sync_every;
+        robust to K-step dispatch boundaries), EXCEPT after a local
+        watchdog escalation, which must surface on the very next poll."""
+        self._step = int(step)
+        self._progress_t = time.monotonic()
+        prev, self._last_polled = self._last_polled, step
+        if not self._escalated and prev >= 0 \
+                and step // self.sync_every <= prev // self.sync_every:
+            return
+        self._raise_observed_failures()
+
+    def _raise_observed_failures(self) -> None:
+        gen_dir = self._require_gen()
+        fails = self._failures(gen_dir)
+        now = time.time()
+        own = fails.pop(self.pi, None)
+        if fails:
+            peers = sorted(fails)
+            newest = max((f.get("unix_time", now) for f in fails.values()),
+                         default=now)
+            detect = max(now - newest, 0.0)
+            if self._goodput is not None:
+                self._goodput.count("peer_failures")
+                self._goodput.add("detect_s", detect)
+            raise PeerFailure(
+                f"host(s) {peers} failed in generation {self._gen} "
+                f"({fails[peers[0]].get('kind', '?')}: "
+                f"{fails[peers[0]].get('reason', '?')}); abandoning this "
+                f"attempt so the pod restarts together "
+                f"(observed {detect:.2f}s after the marker landed)")
+        if own is not None:
+            # our OWN marker with nobody else's: the watchdog escalated a
+            # local hang and the abort was intercepted (test harness) —
+            # surface it as the restartable fault it is
+            raise StepTimeout(
+                f"host {self.pi}: step watchdog escalated "
+                f"({own.get('reason', 'no step progress')}); restarting")
+        stale = self._stale_peers(now)
+        if stale:
+            pi0, age = stale[0]
+            if self._goodput is not None:
+                self._goodput.count("peer_failures")
+                # detect_s = failure-to-observed latency.  The peer died
+                # (silently — no FAIL marker) at roughly its last
+                # heartbeat, so the full silence AGE is the latency
+                # (over-estimates by at most hb_interval_s); it is
+                # necessarily >= peer_timeout_s — a silent death cannot
+                # be detected faster than the staleness threshold
+                self._goodput.add("detect_s", age)
+            raise PeerFailure(
+                f"host(s) {[p for p, _ in stale]} heartbeat-stale "
+                f"(oldest {age:.1f}s > peer_timeout_s="
+                f"{self.peer_timeout_s:.0f}) in generation {self._gen} — "
+                f"treating as dead and restarting the pod")
+
+    def _stale_peers(self, now: float) -> List[Tuple[int, float]]:
+        """[(peer index, silence age)] for peers silent past the
+        timeout.  A missing heartbeat is aged from this attempt's start
+        (peers that merely haven't launched yet get the same grace as
+        slow first heartbeats)."""
+        if self.pc <= 1 or self.peer_timeout_s <= 0:
+            return []
+        gen_dir = self._require_gen()
+        exited = set(self._exited_peers())
+        out = []
+        for pi in range(self.pc):
+            if pi == self.pi or pi in exited:
+                # an exited peer FINISHED — its quiet heartbeat is
+                # success, not death; stragglers keep running
+                continue
+            try:
+                t = os.path.getmtime(self._marker("HB", pi, gen_dir))
+            except OSError:
+                t = self._attempt_wall_t
+            age = now - t
+            if age > self.peer_timeout_s:
+                out.append((pi, age))
+        return out
+
+    # -- restore step agreement (fs-simulated pods) ------------------------
+
+    def gather_restored_step(self, step: int,
+                             phase: str = "agree") -> np.ndarray:
+        """Filesystem allgather of every host's restored checkpoint step
+        (−1 = nothing restored) — the restore agreement barrier for
+        fs-SIMULATED pods, where jax is single-process per host and the
+        manager's real ``all_gather_across_processes`` would see only
+        itself.  Same rendezvous property as the collective: every host
+        blocks here until all have joined (so process 0's pre-agreement
+        residue sweep stays race-free), and a FAIL marker or timeout
+        raises :class:`PeerFailure` instead of deadlocking on a host
+        that died mid-restore.  ``phase`` names the barrier — the
+        manager enters twice per restore ("enter" = pre-walk
+        rendezvous after draining in-flight writes, "agree" = the
+        post-walk step agreement), and each phase needs its own marker
+        file.  One restore per generation (the supervisor wiring
+        guarantees it — each attempt enters a fresh generation after
+        any failure)."""
+        gen_dir = self._require_gen()
+        kind = "RESTORE" if phase == "agree" else f"R{phase.upper()}"
+        _write_json_atomic(self._marker(kind, self.pi),
+                           {"step": int(step)})
+        deadline = time.monotonic() + self.gather_timeout_s
+        while True:
+            vals = []
+            for pi in range(self.pc):
+                got = _read_json(self._marker(kind, pi, gen_dir))
+                if got is None:
+                    break
+                vals.append(got["step"])
+            else:
+                return np.asarray(vals, np.int32)
+            fails = {p: f for p, f in self._failures(gen_dir).items()
+                     if p != self.pi}
+            if fails:
+                raise PeerFailure(
+                    f"host(s) {sorted(fails)} failed while this host was "
+                    f"waiting in the restore-agreement barrier "
+                    f"(generation {self._gen})")
+            done = [p for p in self._exited_peers()
+                    if _read_json(self._marker(kind, p, gen_dir)) is None]
+            if done:
+                # a peer that already COMPLETED the run will never join
+                # this barrier — fail fast (every retry will fail the
+                # same way until the restart budget runs out, each in
+                # milliseconds instead of a full gather timeout)
+                raise PeerFailure(
+                    f"host(s) {done} already completed the run (EXIT "
+                    f"marker) and can never join the generation "
+                    f"{self._gen} restore barrier — the pod finished "
+                    f"without this host; restore the final checkpoint "
+                    f"manually or rerun against a fresh directory")
+            if time.monotonic() > deadline:
+                raise PeerFailure(
+                    f"restore-agreement barrier timed out after "
+                    f"{self.gather_timeout_s:.0f}s in generation "
+                    f"{self._gen}: {self.pc - len(vals)} host(s) never "
+                    f"joined")
+            time.sleep(0.05)
+
+    # -- health watchdog ---------------------------------------------------
+
+    def watch_steps(self):
+        """Context manager arming the local step watchdog for an epoch's
+        dispatch loop (heartbeats run regardless; only the no-progress
+        escalation is scoped, so eval/restore phases can't false-
+        trigger).  ``step_timeout_s`` must exceed the worst-case
+        (re)compile of one dispatch — it defaults to 0 (off)."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _ctx():
+            self._progress_t = time.monotonic()
+            self._watching = True
+            try:
+                yield
+            finally:
+                self._watching = False
+        return _ctx()
+
+    def pause_watch(self):
+        """Context manager suspending the LOCAL no-progress escalation
+        around legitimate blocking work on the step thread — cadence
+        saves that drain a prior write's commit barrier (up to
+        commit_timeout_s, typically far beyond any sane
+        step_timeout_s), the preemption emergency save — so a healthy
+        host is never SIGKILLed mid-save.  Heartbeats keep running (the
+        host IS alive, the peers must see that), and a genuinely
+        wedged save stays bounded by its own timeout (TimeoutError →
+        counted save failure) rather than needing the watchdog.  The
+        step clock restarts fresh on resume."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _ctx():
+            was = self._watching
+            self._watching = False
+            try:
+                yield
+            finally:
+                self._progress_t = time.monotonic()
+                self._watching = was
+        return _ctx()
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._watchdog_body, name=f"fdt-pod-wd-{self.pi}",
+                daemon=True)
+            self._thread.start()
+
+    def _watchdog_body(self) -> None:
+        while not self._stop.wait(self.hb_interval_s):
+            try:
+                self._write_heartbeat()
+            except OSError:
+                pass  # a flaky shared fs must not kill the watchdog
+            if (self._watching and not self._escalated
+                    and self.step_timeout_s > 0
+                    and time.monotonic() - self._progress_t
+                    > self.step_timeout_s):
+                self._escalate_hang()
+
+    def _write_heartbeat(self) -> None:
+        if self._gen_dir is None:
+            return
+        _write_json_atomic(self._marker("HB", self.pi),
+                           {"step": self._step,
+                            "unix_time": round(time.time(), 3)})
+
+    def _escalate_hang(self) -> None:
+        """Watchdog-thread escalation: the main thread has made no step
+        progress for step_timeout_s — it is wedged in a dispatch or a
+        collective and cannot raise for itself.  Publish the failure
+        durably FIRST (so the peers restart even if the abort below is
+        instant), then abort."""
+        self._escalated = True
+        stuck = time.monotonic() - self._progress_t
+        reason = (f"no step progress for {stuck:.1f}s "
+                  f"(> step_timeout_s={self.step_timeout_s:.0f}) "
+                  f"at step {self._step}")
+        try:
+            self._write_fail("hang", reason)
+        except OSError:
+            pass  # peers fall back to heartbeat staleness
+        if self._goodput is not None:
+            self._goodput.count("step_timeouts")
+        self._log(f"[pod] host {self.pi}: WATCHDOG: {reason}; FAIL marker "
+                  f"written, aborting so the pod converges on a restart")
+        self._abort(reason)
+
+    @staticmethod
+    def _default_abort(reason: str) -> None:
+        # SIGKILL, not sys.exit/os._exit: the main thread may be wedged
+        # inside a device runtime call holding locks that Python-level
+        # teardown (atexit, GC finalizers, PJRT client destructors) would
+        # deadlock on.  Nothing softer is guaranteed to terminate a
+        # process whose main thread is stuck in C.
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- housekeeping ------------------------------------------------------
+
+    def _prune_generations(self, keep: int = 3) -> None:
+        """Old generation dirs are a few marker files each; process 0
+        sweeps all but the newest ``keep`` so a long-lived flaky pod
+        doesn't accumulate thousands of dirs.  Kept generations must
+        include every one a lagging peer could still be reading (a peer
+        is at most one incident behind — it restarts the moment it
+        observes the newest FAIL markers)."""
+        if self.pi != 0 or self._gen is None:
+            return
+        for gen, d in self._generations():
+            if gen <= self._gen - keep:
+                shutil.rmtree(d, ignore_errors=True)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.hb_interval_s + 5.0)
+            self._thread = None

@@ -11,7 +11,15 @@ and fire at exact host-side step/batch counters, never randomly:
     save end-to-end, signal delivery included);
   * ``FDT_FAULT_DATA_AT_BATCH=K``    — raise from inside the data
     iterator at batch index K of every epoch (exercises the prefetch
-    pipeline's error propagation and the supervisor above it).
+    pipeline's error propagation and the supervisor above it);
+  * ``FDT_FAULT_HANG_AT_STEP=N``     — block forever at step N (a
+    host-side stand-in for a wedged device program or a collective
+    stuck on a dead peer): the r10 pod-scale arm that only the health
+    watchdog can clear — nothing raises, nothing exits, the step clock
+    just stops (resilience/coordinator.py escalates);
+  * ``FDT_FAULT_HOST=P``             — scope EVERY armed fault above to
+    the host with pod process index P (the other hosts of a simulated
+    or real pod run fault-free); unset = every process.
 
 Each fault fires ONCE per process: after a supervisor restart the
 replayed step must succeed, otherwise every injected crash would look
@@ -26,11 +34,14 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 from typing import Iterable, Iterator, Optional
 
 ENV_DIE = "FDT_FAULT_DIE_AT_STEP"
 ENV_SIGTERM = "FDT_FAULT_SIGTERM_AT_STEP"
 ENV_DATA = "FDT_FAULT_DATA_AT_BATCH"
+ENV_HANG = "FDT_FAULT_HANG_AT_STEP"
+ENV_HOST = "FDT_FAULT_HOST"
 
 
 class InjectedFault(RuntimeError):
@@ -52,24 +63,46 @@ def _env_int(env: dict, key: str) -> Optional[int]:
 class FaultPlan:
     def __init__(self, die_at: Optional[int] = None,
                  sigterm_at: Optional[int] = None,
-                 data_at: Optional[int] = None):
+                 data_at: Optional[int] = None,
+                 hang_at: Optional[int] = None):
         self.die_at = die_at
         self.sigterm_at = sigterm_at
         self.data_at = data_at
+        self.hang_at = hang_at
         self._die_fired = False
         self._sigterm_fired = False
         self._data_fired = False
+        self._hang_fired = False
+        # production never sets this — the hang "ends" when the watchdog
+        # SIGKILLs the process; in-process tests set it from an injected
+        # watchdog abort_fn so the pytest process survives the exercise
+        self.hang_release = threading.Event()
 
     @classmethod
-    def from_env(cls, env=os.environ) -> Optional["FaultPlan"]:
+    def from_env(cls, env=os.environ,
+                 process_index: Optional[int] = None
+                 ) -> Optional["FaultPlan"]:
         """The armed plan, or None when no FDT_FAULT_* is set (the
-        common case — callers skip every per-step hook)."""
+        common case — callers skip every per-step hook).  With
+        ``FDT_FAULT_HOST`` set, only the pod process with that index
+        gets the plan (``process_index`` defaults to
+        :func:`coordinator.pod_identity`, so the env seam and real
+        multi-host runs both scope correctly)."""
         die = _env_int(env, ENV_DIE)
         sig = _env_int(env, ENV_SIGTERM)
         data = _env_int(env, ENV_DATA)
-        if die is None and sig is None and data is None:
+        hang = _env_int(env, ENV_HANG)
+        if die is None and sig is None and data is None and hang is None:
             return None
-        return cls(die_at=die, sigterm_at=sig, data_at=data)
+        host = _env_int(env, ENV_HOST)
+        if host is not None:
+            if process_index is None:
+                from faster_distributed_training_tpu.resilience.coordinator \
+                    import pod_identity
+                process_index = pod_identity(env)[0]
+            if int(process_index) != host:
+                return None
+        return cls(die_at=die, sigterm_at=sig, data_at=data, hang_at=hang)
 
     def on_step(self, step: int) -> None:
         """Called by the train loop after each completed global step."""
@@ -79,6 +112,13 @@ class FaultPlan:
             # a REAL signal to this process: the preemption handler's
             # delivery path is part of what the harness exercises
             os.kill(os.getpid(), signal.SIGTERM)
+        if (self.hang_at is not None and step >= self.hang_at
+                and not self._hang_fired):
+            self._hang_fired = True
+            # block the main thread indefinitely — from the outside this
+            # is indistinguishable from a wedged dispatch/collective,
+            # which is the point: only the watchdog thread can act
+            self.hang_release.wait()
         if (self.die_at is not None and step >= self.die_at
                 and not self._die_fired):
             self._die_fired = True

@@ -20,12 +20,17 @@ from contextlib import contextmanager
 from typing import Dict, Optional
 
 # badput wall-time segments (seconds); anything not in a segment while
-# the clock runs is counted productive
+# the clock runs is counted productive.  detect_s = failure-to-observed
+# latency (a peer's FAIL marker / heartbeat staleness, the pod
+# coordinator's time-to-detect MTTR component; own-crash restarts cost
+# ~0 detection)
 _SEGMENTS = ("checkpoint_blocking_s", "emergency_save_s", "restore_s",
-             "restart_backoff_s", "rollback_lost_s")
-# event counters
+             "restart_backoff_s", "rollback_lost_s", "detect_s")
+# event counters (peer_failures / step_timeouts / restart_generations:
+# pod-coordinated restarts, resilience/coordinator.py)
 _COUNTERS = ("saves", "skipped_saves", "save_failures", "shard_writes",
-             "restores", "restarts", "preemptions", "steps")
+             "restores", "restarts", "preemptions", "steps",
+             "peer_failures", "step_timeouts", "restart_generations")
 
 
 class GoodputTracker:
@@ -38,6 +43,10 @@ class GoodputTracker:
         self._t0: Optional[float] = None
         self._seg: Dict[str, float] = {k: 0.0 for k in _SEGMENTS}
         self._cnt: Dict[str, int] = {k: 0 for k in _COUNTERS}
+        # restore_s accrued BEFORE the first restart (a --resume/auto-
+        # resume start) is not recovery work — snapshotted when the
+        # first restart lands so the MTTR numerator excludes it
+        self._restore_pre_restart: Optional[float] = None
 
     def start(self) -> "GoodputTracker":
         if self._t0 is None:
@@ -54,6 +63,8 @@ class GoodputTracker:
         if counter not in self._cnt:
             raise KeyError(f"unknown counter {counter!r}; "
                            f"want one of {_COUNTERS}")
+        if counter == "restarts" and self._restore_pre_restart is None:
+            self._restore_pre_restart = self._seg["restore_s"]
         self._cnt[counter] += n
 
     @contextmanager
@@ -83,4 +94,19 @@ class GoodputTracker:
         if self._cnt["steps"]:
             out["productive_step_ms"] = round(
                 productive / self._cnt["steps"] * 1e3, 3)
+        if self._cnt["restarts"]:
+            # mean time-to-recover per restart: detection latency (peer
+            # marker/staleness observation) + supervisor backoff +
+            # checkpoint restore — the r10 MTTR headline the
+            # restart_mttr_s bench arm tracks.  Rollback replay cost is
+            # deliberately separate (rollback_lost_s): it scales with
+            # checkpoint cadence, not with recovery machinery.  Only
+            # restore time spent AFTER the first restart counts — the
+            # restore a resumed run starts from is startup, not
+            # recovery, and would otherwise inflate the headline.
+            recovery_restore = (self._seg["restore_s"]
+                                - (self._restore_pre_restart or 0.0))
+            out["restart_mttr_s"] = round(
+                (self._seg["detect_s"] + self._seg["restart_backoff_s"]
+                 + recovery_restore) / self._cnt["restarts"], 3)
         return out

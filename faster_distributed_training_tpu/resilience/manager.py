@@ -97,7 +97,8 @@ class AsyncCheckpointManager:
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
                  shard_owner: Optional[Callable] = None,
-                 commit_timeout_s: float = 600.0):
+                 commit_timeout_s: float = 600.0,
+                 step_gather_fn: Optional[Callable] = None):
         self.directory = os.path.abspath(directory)
         self.prefix = prefix
         self.every_steps = int(every_steps)
@@ -111,6 +112,10 @@ class AsyncCheckpointManager:
         self._sharded = bool(force_sharded) or self._pc > 1
         self._shard_owner = shard_owner
         self._commit_timeout_s = float(commit_timeout_s)
+        # restore step-agreement transport override: fs-SIMULATED pods
+        # (jax single-process per host) pass the pod coordinator's
+        # marker-file allgather here; real pods keep the jax collective
+        self._step_gather_fn = step_gather_fn
         self._delete = delete_fn or _local_delete_tree
         if self.every_secs and self._pc > 1:
             # the wall-clock term reads each host's OWN monotonic clock,
@@ -386,6 +391,15 @@ class AsyncCheckpointManager:
         was written in, so a pod run resumes from a pre-sharding
         checkpoint (and vice versa) transparently."""
         self._drain_inflight()
+        # Pre-walk rendezvous (r10): no host may WALK until every host
+        # has drained its in-flight background write.  Without it, a
+        # host that restarts quickly after a pod failure walks the
+        # directory before a slower peer's two-phase COMMIT lands,
+        # restores an older step (or nothing), and the agreement below
+        # kills the attempt with RestoreDivergence — burning a whole
+        # restart generation on a transient that draining fixes.  One
+        # extra allgather per restore; restores are rare.
+        self._rendezvous()
         result, restored_step, t0 = None, -1, time.monotonic()
         for step, name in reversed(self._entries()):
             path = os.path.join(self.directory, name)
@@ -425,8 +439,10 @@ class AsyncCheckpointManager:
         # whose walk fell back — or exhausted every entry — must still
         # meet its peers in the collective, or they would block forever
         # waiting for it instead of raising
-        self._verify_restore_agreement(self._gather_restored_steps(
-            restored_step))
+        gathered = (self._step_gather_fn(restored_step, phase="agree")
+                    if self._step_gather_fn is not None
+                    else self._gather_restored_steps(restored_step))
+        self._verify_restore_agreement(gathered)
         if result is None:
             return None
         if self._goodput:
@@ -434,6 +450,17 @@ class AsyncCheckpointManager:
             self._goodput.add("restore_s", time.monotonic() - t0)
         self._last_save_step = restored_step
         return result
+
+    def _rendezvous(self) -> None:
+        """The pre-walk barrier of restore_latest: joined by every host
+        AFTER draining its in-flight write, so the newest checkpoint's
+        COMMIT (or its absence) is identical in every host's subsequent
+        walk.  The gathered values are ignored — only the rendezvous
+        matters."""
+        if self._step_gather_fn is not None:
+            self._step_gather_fn(0, phase="enter")
+        else:
+            self._gather_restored_steps(0)
 
     @staticmethod
     def _gather_restored_steps(step: int) -> np.ndarray:

@@ -10,37 +10,63 @@ restore from the newest VALID checkpoint via the manager, then
   * restarts are BOUNDED (``max_restarts`` total) — a run that keeps
     dying is surfaced, not silently retried forever;
   * DETERMINISTIC crashes short-circuit: if two consecutive attempts
-    fail at the same global step, the bug reproduces on replay (bad
-    batch, NaN-poisoned state older than every checkpoint, code bug) and
-    retrying is futile — the original exception re-raises immediately,
-    with retries still in budget;
+    fail at the same global step WITH the same exception type, the bug
+    reproduces on replay (bad batch, NaN-poisoned state older than
+    every checkpoint, code bug) and retrying is futile — the original
+    exception re-raises immediately, with retries still in budget.
+    The type comparison matters (r10 satellite fix): two DIFFERENT
+    transient faults landing at one step — a storage flake, then a peer
+    failure at the same checkpoint-cadence step — are not evidence of
+    determinism and keep retrying while budget remains.  Two failures
+    with progress() None (neither attempt completed a step) compare
+    like any other repeated step: same exception type twice before
+    step 0 means the run cannot even start, and replaying is futile;
   * :class:`Preempted` passes straight through — an emergency save
     already landed and the PLATFORM owns the restart, so retrying
     in-process would fight the scheduler for the grace window.
 
+Pod coordination (r10): given a ``coordinator``
+(resilience/coordinator.py), every attempt is entered through
+``coordinator.begin_attempt()`` — the shared-fs generation rendezvous
+that makes all hosts of a pod restart into the SAME generation — and
+every failure is published through ``coordinator.record_failure()``
+before the backoff, so the peers observe it at their next poll instead
+of blocking forever inside the next collective.  A
+:class:`~faster_distributed_training_tpu.resilience.coordinator.PeerFailure`
+is just another restartable exception here: each host burns a restart
+for it, so a flapping peer exhausts EVERY host's budget together and
+the pod converges on giving up rather than half-running.  (It is
+exempt from the deterministic-crash check — a PeerFailure's step is
+the poll-quantized OBSERVATION point, not the fault point, so two at
+one step carry no replay-determinism signal.)  A host that completes
+``attempt`` durably records its completion, so a peer restarting after
+this host exits fails fast instead of waiting out the restore barrier.
+
 The supervisor knows nothing about jax or checkpoints — it sequences
 ``attempt``/``progress`` callables, which is what makes it testable with
-plain functions and reusable by the smoke script."""
+plain functions and reusable by the smoke scripts."""
 
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 from faster_distributed_training_tpu.resilience import Preempted
+from faster_distributed_training_tpu.resilience.coordinator import PeerFailure
 
 
 class Supervisor:
     def __init__(self, max_restarts: int = 3, backoff_base: float = 1.0,
                  backoff_cap: float = 30.0, goodput=None,
                  sleep: Callable[[float], None] = time.sleep,
-                 log: Callable[[str], None] = print):
+                 log: Callable[[str], None] = print, coordinator=None):
         self.max_restarts = int(max_restarts)
         self.backoff_base = float(backoff_base)
         self.backoff_cap = float(backoff_cap)
         self._goodput = goodput
         self._sleep = sleep
         self._log = log
+        self._coordinator = coordinator
 
     def run(self, attempt: Callable[[int], Any],
             progress: Callable[[], Optional[int]]) -> Any:
@@ -49,23 +75,47 @@ class Supervisor:
         k resumes from whatever checkpoint is newest AFTER failure k-1).
         progress() reports the global step reached, read after a failure
         for the deterministic-crash check."""
-        last_fail_step: Optional[int] = None
+        # (step-or-None, exception type) of the previous failure: the
+        # deterministic-crash check needs BOTH to call a replay futile
+        last_fail: Optional[Tuple[Optional[int], type]] = None
         restarts = 0
         while True:
             try:
-                return attempt(restarts)
+                if self._coordinator is not None:
+                    self._coordinator.begin_attempt()
+                result = attempt(restarts)
+                if self._coordinator is not None:
+                    # durably mark this host DONE so a peer restarting
+                    # AFTER our exit fails its restore barrier fast
+                    # ("pod already finished") instead of waiting out
+                    # the full gather timeout for a host that is gone
+                    self._coordinator.record_completion()
+                return result
             except Preempted:
                 raise                       # clean shutdown, never retried
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:
                 step = progress()
-                if last_fail_step is not None and step == last_fail_step:
+                if self._coordinator is not None:
+                    # publish to the pod BEFORE the backoff so the peers'
+                    # next poll observes it while this host sleeps
+                    self._coordinator.record_failure(e, step=step)
+                # PeerFailure never participates in the deterministic-
+                # crash check: its step is the OBSERVATION point (poll-
+                # boundary-quantized, typically the restored step), not
+                # the fault point, so two observations at one step carry
+                # no replay-determinism signal — and short-circuiting
+                # here would make a survivor give up on a flapping peer
+                # with retry budget remaining, breaking the "the pod
+                # exhausts every host's budget together" contract.
+                transient_peer = isinstance(e, PeerFailure)
+                if not transient_peer and last_fail == (step, type(e)):
                     self._log(
-                        f"[supervisor] step {step} failed twice in a row — "
-                        f"the crash is deterministic (reproduces on replay "
-                        f"from the same checkpoint); re-raising instead of "
-                        f"looping")
+                        f"[supervisor] step {step} failed twice in a row "
+                        f"with {type(e).__name__} — the crash is "
+                        f"deterministic (reproduces on replay from the "
+                        f"same checkpoint); re-raising instead of looping")
                     raise
                 restarts += 1
                 if restarts > self.max_restarts:
@@ -87,4 +137,8 @@ class Supervisor:
                             self._sleep(delay)
                     else:
                         self._sleep(delay)
-                last_fail_step = step
+                if not transient_peer:
+                    # a PeerFailure neither records NOR clears the pair:
+                    # an own-crash recurring at one step with a peer
+                    # incident in between is still deterministic
+                    last_fail = (step, type(e))

@@ -446,22 +446,71 @@ def is_sharded_checkpoint(path: str) -> bool:
     return os.path.isdir(os.path.join(path, _SHARDS))
 
 
+def _normalized_regions(index, shape) -> Tuple[Tuple[Tuple[int, int], ...]]:
+    """One slice-tuple as ((start, stop) per dim), defaults resolved."""
+    return tuple((s.start if s.start is not None else 0,
+                  s.stop if s.stop is not None else dim)
+                 for s, dim in zip(index, shape))
+
+
+def _region_overlap(a, b) -> int:
+    """Element count of the intersection of two ((start, stop), ...)
+    regions over the same shape (0 = disjoint; rank-0 regions — both
+    empty tuples — overlap fully with count 1)."""
+    n = 1
+    for (a0, a1), (b0, b1) in zip(a, b):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if hi <= lo:
+            return 0
+        n *= hi - lo
+    return n
+
+
+def template_needed_regions(template_leaf) -> Optional[list]:
+    """The index regions of `template_leaf` THIS process must fill at
+    restore — its addressable shards' indices (deduped: replicated local
+    devices share one region), or None = the whole leaf (single-process
+    runs, and non-jax template leaves, which have no sharding to
+    consult).  The per-host read-filtering seam of
+    :func:`restore_sharded_checkpoint`."""
+    sharding = getattr(template_leaf, "sharding", None)
+    if sharding is None or jax.process_count() == 1:
+        return None
+    shape = np.shape(template_leaf)
+    try:
+        index_map = sharding.addressable_devices_indices_map(shape)
+    except (AttributeError, TypeError):
+        return None      # exotic sharding: fall back to reading everything
+    regions = {_normalized_regions(idx, shape)
+               for idx in index_map.values() if idx is not None}
+    if not regions or None in index_map.values():
+        return None
+    return [tuple(slice(lo, hi) for lo, hi in r) for r in sorted(regions)]
+
+
 def restore_sharded_checkpoint(checkpoint_dir: str, name: str,
-                               state: TrainState
+                               state: TrainState,
+                               needed_fn=None, stats: Optional[dict] = None
                                ) -> Tuple[TrainState, int, float]:
-    """Reassemble the full state from every host's shard file and fit it
+    """Reassemble the state from the per-host shard files and fit it
     onto the (freshly created) `state` template — the sharded analog of
-    :func:`restore_checkpoint`, same return contract.  Every host reads
-    ALL shard files and materializes each leaf at its full global shape
-    in host numpy — O(total state) host RAM and pc× the necessary fs
-    reads per host.  Fine at this repo's state sizes (MBs; the
-    collective orbax restore reassembles on host too), but a state
-    sharded BECAUSE one host can't hold it needs block filtering by
-    overlap with the template's addressable shards before this scales —
-    ROADMAP records that follow-on.  The reassembled leaves are
-    re-placed per the template's shardings on multi-host runs.  A leaf
-    whose blocks do not tile its template shape exactly raises — the
-    resilience manager's newest-VALID walk then falls back past it."""
+    :func:`restore_checkpoint`, same return contract.
+
+    Each host reads ONLY the manifest entries overlapping its needed
+    regions — by default the template's addressable-shard indices
+    (:func:`template_needed_regions`) — and fills a per-host partial
+    buffer; the npz members of skipped blocks are never decompressed
+    (``np.load`` on an npz reads lazily per member), so per-host bytes
+    read scale with the host's shard of the state, not its global size.
+    ``_placed_like`` then asks the buffer for exactly the addressable
+    indices, so the unfilled remainder is never observed.  Single-process
+    restores (and non-jax leaves) need everything and degenerate to the
+    full read.  ``needed_fn(leaf_keystr, template_leaf) -> regions|None``
+    overrides the region source (the simulated-pod tests' seam);
+    ``stats`` (optional dict) receives bytes_read / blocks_read /
+    blocks_skipped.  A leaf whose read blocks do not cover every needed
+    region exactly raises — the resilience manager's newest-VALID walk
+    then falls back past it."""
     import glob as _glob
 
     path = _ckpt_dir(checkpoint_dir, name)
@@ -471,7 +520,14 @@ def restore_sharded_checkpoint(checkpoint_dir: str, name: str,
     t_paths, _ = jax.tree_util.tree_flatten_with_path(template)
     keys = [jax.tree_util.keystr(p) for p, _v in t_paths]
     key_to_leaf = dict(zip(keys, t_flat))
-    out = {}      # keystr -> (np array being filled, filled element count)
+    if needed_fn is None:
+        needed_fn = lambda _key, tv: template_needed_regions(tv)  # noqa: E731
+    # keystr -> [target buffer, [(normalized region, covered count)]]
+    # (None regions = whole leaf).  Blocks are globally disjoint (the
+    # replica-0 owner cover write_host_shards records), so per-region
+    # coverage is an exact sum of block intersections.
+    out = {}
+    st = {"bytes_read": 0, "blocks_read": 0, "blocks_skipped": 0}
     for jf in sorted(_glob.glob(os.path.join(d, "host_*.json"))):
         with open(jf) as f:
             manifest = json.load(f)
@@ -481,32 +537,56 @@ def restore_sharded_checkpoint(checkpoint_dir: str, name: str,
             if key not in key_to_leaf:
                 raise ValueError(f"sharded checkpoint leaf {key} not in "
                                  f"the restore template")
-            block = np.frombuffer(
-                npz[entry["npz"]].tobytes(),
-                np.dtype(entry["dtype"])).reshape(entry["shape"])
             tv = key_to_leaf[key]
             if key not in out:
                 dt = tv.dtype if hasattr(tv, "dtype") else \
                     np.asarray(tv).dtype
-                out[key] = [np.zeros(np.shape(tv), dt), 0]
-            target, filled = out[key]
-            if entry["index"] is None or block.shape == target.shape:
+                shape = np.shape(tv)
+                needed = needed_fn(key, tv)
+                if needed is None:
+                    needed = [tuple(slice(0, s) for s in shape)]
+                out[key] = [np.zeros(shape, dt),
+                            [[_normalized_regions(r, shape), 0]
+                             for r in needed]]
+            target, regions = out[key]
+            block_region = _normalized_regions(
+                _json_to_index(entry["index"], target.shape)
+                if entry["index"] is not None
+                else tuple(slice(0, s) for s in target.shape),
+                target.shape)
+            overlaps = [(r, _region_overlap(block_region, r[0]))
+                        for r in regions]
+            if not any(n for _r, n in overlaps):
+                st["blocks_skipped"] += 1
+                continue        # npz member never touched: bytes unread
+            block = np.frombuffer(
+                npz[entry["npz"]].tobytes(),
+                np.dtype(entry["dtype"])).reshape(entry["shape"])
+            st["blocks_read"] += 1
+            st["bytes_read"] += block.nbytes
+            if block.shape == target.shape:
                 target[...] = block.astype(target.dtype, copy=False)
-                out[key][1] = target.size
             else:
-                slc = _json_to_index(entry["index"], target.shape)
+                slc = tuple(slice(lo, hi) for lo, hi in block_region)
                 target[slc] = block.astype(target.dtype, copy=False)
-                out[key][1] = filled + block.size
+            for r, n in overlaps:
+                r[1] += n
     leaves = []
     for key, tv in zip(keys, t_flat):
         if key not in out:
             raise ValueError(f"sharded checkpoint is missing leaf {key}")
-        target, filled = out[key]
-        if filled < target.size:
-            raise ValueError(
-                f"sharded checkpoint leaf {key} incomplete: {filled} of "
-                f"{target.size} elements covered by the host shard files")
+        target, regions = out[key]
+        for (region, covered) in regions:
+            want = int(np.prod([hi - lo for lo, hi in region])) \
+                if region else 1
+            if covered < want:
+                raise ValueError(
+                    f"sharded checkpoint leaf {key} incomplete: region "
+                    f"{region} has {covered} of {want} elements covered "
+                    f"by the host shard files")
         leaves.append(_placed_like(tv, target))
+    if stats is not None:
+        stats.update(st)
     restored = jax.tree_util.tree_unflatten(treedef, leaves)
     meta = read_checkpoint_meta(checkpoint_dir, name)
     new_state = state.replace(

@@ -13,6 +13,7 @@ transformer_test.py:205-347).  Differences by design:
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import math
 import time
@@ -341,36 +342,64 @@ class Trainer:
         res.goodput.count("steps", n_steps)
         if res.faults is not None:
             res.faults.on_step(step)    # may SIGTERM this process / raise
-        if res.preemption is not None and res.preemption.should_stop(step):
-            from faster_distributed_training_tpu.resilience import Preempted
-            res.goodput.count("preemptions")
+        if res.coordinator is not None:
+            # pod health: feed the step clock to the local watchdog and
+            # (cadence-gated) poll the peers' FAIL/heartbeat markers —
+            # raises PeerFailure/StepTimeout, both restartable, so the
+            # whole pod re-enters the supervisor together.  BEFORE the
+            # preemption/save hooks: a dead peer makes the collective
+            # emergency save (and the sharded commit barrier) unreachable,
+            # so failure observation must preempt anything collective.
+            res.coordinator.check(step)
+        # blocking checkpoint work below (emergency save; cadence saves
+        # that DRAIN a prior write's commit barrier, up to
+        # commit_timeout_s) is legitimate step-thread stalling — suspend
+        # the local hang watchdog so a healthy host is never SIGKILLed
+        # mid-save (heartbeats keep running; a wedged save is bounded by
+        # its own timeout)
+        pause = (res.coordinator.pause_watch()
+                 if res.coordinator is not None else contextlib.nullcontext())
+        with pause:
+            if res.preemption is not None and res.preemption.should_stop(step):
+                from faster_distributed_training_tpu.resilience import (
+                    Preempted)
+                res.goodput.count("preemptions")
+                if res.manager is not None:
+                    # the manager bills the save's duration into the
+                    # emergency_save_s segment itself — wrapping it in
+                    # goodput.timed here too would double-count the badput
+                    res.manager.save(state, step, epoch=epoch,
+                                     step_in_epoch=step_in_epoch,
+                                     best_acc=self.best_acc, sync=True,
+                                     segment="emergency_save_s")
+                    self.log(f"[preempt] emergency checkpoint committed at "
+                             f"step {step} (epoch {epoch}); exiting cleanly")
+                else:
+                    self.log(f"[preempt] no checkpoint manager configured — "
+                             f"exiting at step {step} WITHOUT an emergency "
+                             f"save (set --checkpoint_every to get one)")
+                raise Preempted(f"preempted at step {step}", state=state,
+                                step=step)
             if res.manager is not None:
-                # the manager bills the save's duration into the
-                # emergency_save_s segment itself — wrapping it in
-                # goodput.timed here too would double-count the badput
-                res.manager.save(state, step, epoch=epoch,
-                                 step_in_epoch=step_in_epoch,
-                                 best_acc=self.best_acc, sync=True,
-                                 segment="emergency_save_s")
-                self.log(f"[preempt] emergency checkpoint committed at "
-                         f"step {step} (epoch {epoch}); exiting cleanly")
-            else:
-                self.log(f"[preempt] no checkpoint manager configured — "
-                         f"exiting at step {step} WITHOUT an emergency "
-                         f"save (set --checkpoint_every to get one)")
-            raise Preempted(f"preempted at step {step}", state=state,
-                            step=step)
-        if res.manager is not None:
-            res.manager.maybe_save(state, step, epoch=epoch,
-                                   step_in_epoch=step_in_epoch,
-                                   best_acc=self.best_acc)
+                res.manager.maybe_save(state, step, epoch=epoch,
+                                       step_in_epoch=step_in_epoch,
+                                       best_acc=self.best_acc)
         return state
 
     def _save_epoch_checkpoint(self, name: str, state: TrainState,
                                epoch: int) -> None:
         """Epoch-level save (rolling last-good / best-acc), goodput-timed
-        when the resilience bundle is active."""
+        when the resilience bundle is active.
+
+        fs-SIMULATED pods (FDT_POD_INDEX seam): jax is single-process
+        per simulated host, so this orbax save is NOT collective — every
+        host computes the identical full state and concurrent writers on
+        one shared path would race mid-rename.  Host 0 writes it alone;
+        a REAL pod's save is collective and every host must enter."""
         res = self.resilience
+        if (res is not None and res.pod_simulated and res.pod_count > 1
+                and res.pod_index != 0):
+            return
         if res is not None:
             with res.goodput.timed("checkpoint_blocking_s"):
                 ckpt.save_checkpoint(self.cfg.checkpoint_dir, name, state,
@@ -448,11 +477,20 @@ class Trainer:
         while epoch < cfg.epochs:
             # resident mode never builds a host train loader (it would
             # spin up a prefetch thread and materialize batches nobody
-            # consumes); eval below stays on the host path either way
-            state, train_m, elapsed = self.run_epoch(
-                state,
-                None if self.resident is not None else train_loader(epoch),
-                epoch, start_step=resume_step)
+            # consumes); eval below stays on the host path either way.
+            # The pod step watchdog is armed ONLY around the dispatch
+            # loop: eval/restore/checkpoint phases have no step clock to
+            # advance and must not be able to false-trigger a hang
+            # escalation (heartbeats keep running regardless).
+            watch = (res.coordinator.watch_steps()
+                     if res is not None and res.coordinator is not None
+                     else contextlib.nullcontext())
+            with watch:
+                state, train_m, elapsed = self.run_epoch(
+                    state,
+                    None if self.resident is not None
+                    else train_loader(epoch),
+                    epoch, start_step=resume_step)
             resumed_mid_epoch, resume_step = resume_step, 0
             # Failure detection (a deliberate addition — the reference's
             # only recovery is manual re-launch with --resume, SURVEY.md

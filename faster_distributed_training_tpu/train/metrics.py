@@ -76,9 +76,18 @@ def format_goodput(tracker) -> str:
         bits.append(f"restore {s['restore_s']:.2f}s")
     if s.get("restart_backoff_s"):
         bits.append(f"backoff {s['restart_backoff_s']:.2f}s")
+    if s.get("detect_s"):
+        bits.append(f"detect {s['detect_s']:.2f}s")
+    if s.get("restart_mttr_s"):
+        # detect + backoff + restore per restart — the pod-coordinated
+        # recovery headline (resilience/coordinator.py, bench
+        # restart_mttr_s arm)
+        bits.append(f"mttr {s['restart_mttr_s']:.2f}s/restart")
     counts = ", ".join(f"{int(s[k])} {k.rstrip('s') if s[k] == 1 else k}"
                        for k in ("saves", "skipped_saves", "restores",
-                                 "restarts", "preemptions") if s.get(k))
+                                 "restarts", "preemptions", "peer_failures",
+                                 "step_timeouts", "restart_generations")
+                       if s.get(k))
     if counts:
         bits.append(counts)
     return "; ".join(bits)

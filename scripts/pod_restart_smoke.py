@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Pod-restart smoke: a REAL two-process simulated pod (the
+FDT_POD_INDEX/FDT_POD_COUNT seam — jax single-process per host, restart
+coordination and the sharded two-phase checkpoint commit genuinely
+cross-PROCESS through the shared filesystem), with host 1 killed by an
+injected crash scoped via FDT_FAULT_HOST.  Asserts the r10 acceptance
+at process level:
+
+  * both supervisors observe the failure (host 1: its own crash;
+    host 0: the FAIL marker) and restart into the SAME generation;
+  * ``restore_latest`` agrees the same checkpoint step on both hosts
+    (the coordinator's marker-file allgather standing in for the jax
+    collective);
+  * both hosts finish every step with final state byte-identical to an
+    uninterrupted single-process reference run (params/opt/RNG digest);
+  * MTTR components land in the goodput summary.
+
+This is the PROCESS-LEVEL twin of
+tests/test_pod_restart.py::TestSimulatedPodEndToEnd (which runs the
+two hosts as threads): nothing survives between attempts except the
+shared checkpoint/coordination directory, exactly as a relaunched pod
+would see it.
+
+    python scripts/pod_restart_smoke.py          # CPU, ~1 min
+    FDT_SMOKE_DIE_AT=9 python scripts/pod_restart_smoke.py
+
+Prints PASS/FAIL per assertion; exit code 0 iff all pass."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# synthetic AG News, subset_stride 64 -> 64 samples @ bs 8 = 8 steps/epoch
+# x 2 epochs = 16 global steps
+STEPS_PER_EPOCH = 8
+EPOCHS = 2
+TOTAL_STEPS = STEPS_PER_EPOCH * EPOCHS
+CKPT_EVERY = 2     # the cadence's commit barrier also bounds host drift:
+#                    host 0's step-2k tick DRAINS its step-2(k-1) commit,
+#                    which needs host 1's DONE — so unsynchronized
+#                    processes can never drift a full failure past each
+#                    other
+
+
+def reference_cfg(workdir: str):
+    """The uninterrupted single-process reference configuration — the
+    same training math with no pod, no faults, no supervisor."""
+    from faster_distributed_training_tpu.config import TrainConfig
+    return TrainConfig(model="transformer", dataset="synthetic",
+                       num_classes=4, batch_size=8, seq_len=16, n_layers=1,
+                       d_model=16, d_ff=32, n_heads=2, epochs=EPOCHS,
+                       subset_stride=64, optimizer="sgd", precision="fp32",
+                       plot=False, workers=0, log_every=0, donate=False,
+                       checkpoint_dir=workdir)
+
+
+def state_digest(state) -> str:
+    """sha256 over every checkpointable leaf's bytes (params, BN stats,
+    optimizer state, loss scale, step, RNG) — byte-identical final
+    states hash equal."""
+    import jax
+    import numpy as np
+
+    from faster_distributed_training_tpu.train import checkpoint as ckpt
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(ckpt._state_pytree(state)):
+        h.update(np.ascontiguousarray(jax.device_get(leaf)).tobytes())
+    return h.hexdigest()
+
+
+_CHILD = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["FDT_SMOKE_REPO"])
+import importlib.util
+spec = importlib.util.spec_from_file_location(
+    "pod_restart_smoke",
+    os.path.join(os.environ["FDT_SMOKE_REPO"], "scripts",
+                 "pod_restart_smoke.py"))
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+from faster_distributed_training_tpu.cli import run_training
+
+cfg = mod.reference_cfg(os.environ["FDT_SMOKE_DIR"])
+if os.environ.get("FDT_POD_COUNT"):
+    cfg = cfg.replace(supervise=True, checkpoint_every=%(every)d,
+                      preempt_sync_every=1, peer_timeout_s=5.0,
+                      max_restarts=3)
+out = run_training(cfg, log=lambda *a: print(*a, file=sys.stderr))
+print(json.dumps({
+    "final_step": int(out["state"].step),
+    "digest": mod.state_digest(out["state"]),
+    "restarts": int(out.get("goodput_restarts", 0)),
+    "restores": int(out.get("goodput_restores", 0)),
+    "peer_failures": int(out.get("goodput_peer_failures", 0)),
+    "restart_generations": int(out.get("goodput_restart_generations", 0)),
+    "restart_mttr_s": float(out.get("goodput_restart_mttr_s", 0.0)),
+}))
+"""
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(workdir: str, pod: bool, pi: int = 0, die_at: int = 0):
+    env = dict(os.environ, FDT_SMOKE_DIR=workdir, FDT_SMOKE_REPO=_REPO,
+               JAX_PLATFORMS="cpu")
+    for k in ("FDT_POD_INDEX", "FDT_POD_COUNT", "FDT_FAULT_HOST",
+              "FDT_FAULT_DIE_AT_STEP"):
+        env.pop(k, None)
+    if pod:
+        env.update(FDT_POD_INDEX=str(pi), FDT_POD_COUNT="2")
+        if die_at:
+            # the crash is armed in BOTH processes' environments; the
+            # FDT_FAULT_HOST scope is what keeps host 0 fault-free
+            env.update(FDT_FAULT_HOST="1",
+                       FDT_FAULT_DIE_AT_STEP=str(die_at))
+    code = _CHILD % {"every": CKPT_EVERY}
+    return subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _join(proc, label: str) -> dict:
+    out, err = proc.communicate(timeout=900)
+    if proc.returncode != 0:
+        print(f"--- {label} stderr ---\n{err[-3000:]}", file=sys.stderr)
+        raise RuntimeError(f"{label} exited rc={proc.returncode}")
+    return json.loads(out.strip().splitlines()[-1])
+
+
+def main(ref_digest: str = "") -> int:
+    die_at = int(os.environ.get("FDT_SMOKE_DIE_AT", "6"))
+    failures = 0
+
+    def check(name, ok, detail=""):
+        nonlocal failures
+        print(f"[{'PASS' if ok else 'FAIL'}] {name}"
+              + (f" ({detail})" if detail else ""))
+        failures += 0 if ok else 1
+
+    if not ref_digest:
+        print(f"phase 0: uninterrupted single-process reference "
+              f"({TOTAL_STEPS} steps)")
+        ref = _join(_spawn(tempfile.mkdtemp(prefix="fdt_pod_ref_"),
+                           pod=False), "reference")
+        check("reference ran every step",
+              ref["final_step"] == TOTAL_STEPS, str(ref["final_step"]))
+        ref_digest = ref["digest"]
+
+    workdir = tempfile.mkdtemp(prefix="fdt_pod_smoke_")
+    print(f"phase 1: 2-process simulated pod, host 1 dies at step "
+          f"{die_at} (shared dir {workdir})")
+    procs = [_spawn(workdir, pod=True, pi=pi, die_at=die_at)
+             for pi in (0, 1)]
+    h0, h1 = (_join(p, f"host {pi}") for pi, p in enumerate(procs))
+
+    check("both hosts finished every step",
+          h0["final_step"] == h1["final_step"] == TOTAL_STEPS,
+          f"{h0['final_step']}/{h1['final_step']}")
+    check("host 1 restarted from its injected crash",
+          h1["restarts"] >= 1, str(h1["restarts"]))
+    check("host 0 observed the peer failure and restarted with it",
+          h0["peer_failures"] >= 1 and h0["restarts"] >= 1,
+          f"peer_failures={h0['peer_failures']} restarts={h0['restarts']}")
+    check("both hosts advanced into a new shared generation",
+          h0["restart_generations"] >= 1
+          and h0["restart_generations"] == h1["restart_generations"],
+          f"{h0['restart_generations']}/{h1['restart_generations']}")
+    # the generation directory itself records the converged protocol:
+    # the incident landed in gen 0, both hosts' restore-agreement
+    # markers landed in gen 1
+    pod_dir = os.path.join(workdir, "_pod")
+    gens = sorted(n for n in os.listdir(pod_dir) if n.startswith("gen_"))
+    check("shared _pod directory shows the restart generation",
+          "gen_000001" in gens, str(gens))
+    g1 = os.path.join(pod_dir, "gen_000001")
+    agree = sorted(n for n in os.listdir(g1) if n.startswith("RESTORE_"))
+    check("both hosts joined the gen-1 restore agreement",
+          agree == ["RESTORE_00000", "RESTORE_00001"], str(agree))
+    steps = [json.load(open(os.path.join(g1, a)))["step"] for a in agree]
+    check("restore agreement: both hosts restored the SAME step",
+          steps[0] == steps[1] and steps[0] >= 0, str(steps))
+    check("host states byte-identical to each other",
+          h0["digest"] == h1["digest"])
+    check("...and to the uninterrupted reference",
+          h0["digest"] == ref_digest,
+          f"{h0['digest'][:12]} vs {ref_digest[:12]}")
+    check("recovery MTTR landed in the goodput summary",
+          h0["restart_mttr_s"] > 0 and h1["restart_mttr_s"] > 0,
+          f"{h0['restart_mttr_s']}s/{h1['restart_mttr_s']}s")
+
+    print("PASS" if not failures else f"FAIL ({failures} assertion(s))")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -331,6 +331,40 @@ class TestShardedCheckpoint:
                                            timeout_s=0.2)
         assert not ckpt.is_committed(path)
 
+    def test_dead_host_barrier_timeout_swept_and_falls_back(self, tmp_path,
+                                                            tiny):
+        """r10 satellite: the MANAGER-path ordering under a dead host —
+        host 1 dies before its phase-1 DONE, host 0's background commit
+        barrier times out (a counted save FAILURE, training continues),
+        the dir stays uncommitted and invisible, and the next restore
+        sweeps the residue and falls back to the older committed
+        checkpoint."""
+        from faster_distributed_training_tpu.resilience import (
+            GoodputTracker)
+        g = GoodputTracker().start()
+        m0 = AsyncCheckpointManager(str(tmp_path), process_index=0,
+                                    process_count=2,
+                                    shard_owner=lambda sh:
+                                    sh.replica_id == 0,
+                                    every_steps=2, goodput=g,
+                                    log=lambda *_: None,
+                                    commit_timeout_s=0.3)
+        m0.save(tiny, 2, epoch=0, step_in_epoch=2, sync=True)
+        # step 4: host 1 is DEAD — no shard file, no DONE, ever
+        assert m0.save(tiny, 4, epoch=1, step_in_epoch=4)
+        m0.wait()       # drains the barrier TimeoutError
+        s = g.summary()
+        assert s["save_failures"] == 1     # surfaced, not raised
+        torn = os.path.join(str(tmp_path), m0._name(4))
+        assert os.path.isdir(torn)
+        assert not ckpt.has_checkpoint(str(tmp_path), m0._name(4))
+        got = m0.restore_latest(tiny)
+        assert got is not None and got[1]["step"] == 2   # fell back
+        assert not os.path.exists(torn)    # residue swept at restore
+        _assert_tree_equal(ckpt._state_pytree(got[0]),
+                           ckpt._state_pytree(tiny))
+        m0.close()
+
     def test_kill_between_phase1_and_commit_falls_back(self, tmp_path,
                                                        tiny):
         m0, m1 = self._managers(str(tmp_path), every_steps=2)
@@ -406,6 +440,71 @@ class TestShardedCheckpoint:
         got = m0.restore_latest(tiny)
         assert got is not None and got[1]["step"] == 2
         m0.close()
+
+    def test_block_filtered_restore_reads_only_needed_shards(
+            self, tmp_path, tiny):
+        """r10 satellite (ROADMAP r9 follow-on): restore reads ONLY the
+        manifest entries overlapping this host's needed regions and
+        fills a per-host partial buffer — per-host bytes read < full
+        state size.  Simulated 2-host split: every rank>=1 leaf's rows
+        are halved across two shard files; "host 0" needs only the
+        first halves."""
+        name = "ck_step_000000016"
+        path = os.path.join(str(tmp_path), name)
+        b0, b1 = [], []
+        for key, _idx, arr in ckpt.host_shard_snapshot(tiny):
+            if arr.ndim == 0 or arr.shape[0] < 2:
+                b0.append((key, None, arr))
+            else:
+                h = arr.shape[0] // 2
+                rest = tuple(slice(0, s) for s in arr.shape[1:])
+                b0.append((key, (slice(0, h),) + rest, arr[:h]))
+                b1.append((key, (slice(h, arr.shape[0]),) + rest, arr[h:]))
+        ckpt.write_host_shards(path, 0, b0)
+        ckpt.write_host_shards(path, 1, b1)
+        ckpt.commit_sharded_checkpoint(path, {"step": 16, "epoch": 3,
+                                              "best_acc": 0.25},
+                                       n_hosts=2, timeout_s=5.0)
+        full_bytes = sum(arr.nbytes
+                         for _k, _i, arr in ckpt.host_shard_snapshot(tiny))
+
+        def first_half_rows(_key, tv):
+            shape = np.shape(tv)
+            if len(shape) == 0 or shape[0] < 2:
+                return None                      # whole (tiny scalars)
+            return [(slice(0, shape[0] // 2),)
+                    + tuple(slice(0, s) for s in shape[1:])]
+
+        stats = {}
+        restored, epoch, best = ckpt.restore_sharded_checkpoint(
+            str(tmp_path), name, tiny, needed_fn=first_half_rows,
+            stats=stats)
+        assert epoch == 3 and best == 0.25
+        # the filtering is real: the second-half blocks were never read
+        assert stats["blocks_skipped"] > 0
+        assert 0 < stats["bytes_read"] < full_bytes
+        # ...and every needed region restored bitwise
+        want = jax.tree_util.tree_flatten_with_path(
+            ckpt._state_pytree(tiny))[0]
+        got = {jax.tree_util.keystr(p): v for p, v in
+               jax.tree_util.tree_flatten_with_path(
+                   ckpt._state_pytree(restored))[0]}
+        for p, tv in want:
+            key = jax.tree_util.keystr(p)
+            tv = np.asarray(tv)
+            if tv.ndim == 0 or tv.shape[0] < 2:
+                np.testing.assert_array_equal(np.asarray(got[key]), tv)
+            else:
+                h = tv.shape[0] // 2
+                np.testing.assert_array_equal(
+                    np.asarray(got[key])[:h], tv[:h])
+        # the default (no needed_fn, single process) still reads all
+        stats = {}
+        ckpt.restore_sharded_checkpoint(str(tmp_path), name, tiny,
+                                        stats=stats)
+        assert stats["blocks_skipped"] == 0
+        assert stats["bytes_read"] == full_bytes
+        assert ckpt.template_needed_regions(np.zeros((4, 4))) is None
 
     def test_restore_agreement_decision(self):
         """The cross-host restore-divergence check as a pure function of

@@ -207,6 +207,41 @@ class TestFaultPlan:
                 got.append(x)
         assert got == [0, 1]
 
+    def test_host_scoping(self):
+        """r10: FDT_FAULT_HOST scopes any armed fault to one pod
+        process — the other hosts of a (simulated or real) pod run
+        fault-free."""
+        env = {faults_mod.ENV_DIE: "5", faults_mod.ENV_HOST: "1"}
+        assert FaultPlan.from_env(env, process_index=0) is None
+        plan = FaultPlan.from_env(env, process_index=1)
+        assert plan is not None and plan.die_at == 5
+        # unresolved index falls back to the pod-identity env seam
+        assert FaultPlan.from_env(
+            dict(env, FDT_POD_INDEX="1", FDT_POD_COUNT="2")).die_at == 5
+        assert FaultPlan.from_env(
+            dict(env, FDT_POD_INDEX="0", FDT_POD_COUNT="2")) is None
+
+    def test_hang_blocks_until_released_then_fires_once(self):
+        """r10: FDT_FAULT_HANG_AT_STEP really BLOCKS the calling thread
+        (indistinguishable from a wedged dispatch — only the watchdog
+        thread can act); the release event is the test harness's stand-
+        in for the watchdog's SIGKILL, and the fault fires once so the
+        post-restart replay passes."""
+        import threading
+
+        plan = FaultPlan.from_env({faults_mod.ENV_HANG: "3"})
+        assert plan.hang_at == 3
+        plan.on_step(2)                      # not yet
+        t = threading.Timer(0.15, plan.hang_release.set)
+        t.start()
+        t0 = time.monotonic()
+        plan.on_step(3)                      # blocks until released
+        assert time.monotonic() - t0 >= 0.1
+        t.join()
+        t0 = time.monotonic()
+        plan.on_step(3)                      # fired once: replay is free
+        assert time.monotonic() - t0 < 0.1
+
 
 class TestSupervisor:
     def _supervisor(self, **kw):
@@ -236,6 +271,81 @@ class TestSupervisor:
             sup.run(lambda i: (_ for _ in ()).throw(RuntimeError("boom")),
                     lambda: 5)   # same step every time
         assert len(sleeps) == 1   # one retry, then the same-step re-raise
+
+    def test_same_step_different_exception_types_keep_retrying(self):
+        """r10 satellite fix: two DIFFERENT transient faults landing at
+        one step — a storage flake, then a peer failure at the same
+        checkpoint-cadence step — are not evidence of determinism and
+        must keep retrying while budget remains."""
+        sup, sleeps = self._supervisor(max_restarts=5)
+        excs = iter([OSError("storage flake"), RuntimeError("peer died")])
+
+        def attempt(i):
+            e = next(excs, None)
+            if e is not None:
+                raise e
+            return "done"
+
+        assert sup.run(attempt, lambda: 5) == "done"   # same step each time
+        assert len(sleeps) == 2      # both failures retried, none fatal
+
+    def test_peer_failure_never_deterministic(self):
+        """r10 review fix: a PeerFailure's step is the poll-quantized
+        OBSERVATION point, not the fault point — repeated PeerFailure
+        at one step must keep retrying (a flapping peer exhausts the
+        whole budget, never the two-strikes short-circuit), and it
+        neither records nor clears the (step, type) pair an own-crash
+        determinism check runs on."""
+        from faster_distributed_training_tpu.resilience import PeerFailure
+        sup, sleeps = self._supervisor(max_restarts=3)
+        with pytest.raises(PeerFailure):    # budget-exhausted, not
+            sup.run(lambda i: (_ for _ in ()).throw(   # deterministic
+                PeerFailure("host 1 flapping")), lambda: 5)
+        assert len(sleeps) == 3             # every restart was burned
+        # ...and an own-crash recurring at one step with a peer incident
+        # in between is STILL deterministic (PeerFailure is transparent)
+        sup, sleeps = self._supervisor(max_restarts=10)
+        excs = iter([RuntimeError("bad batch"), PeerFailure("peer"),
+                     RuntimeError("bad batch")])
+        with pytest.raises(RuntimeError, match="bad batch"):
+            sup.run(lambda i: (_ for _ in ()).throw(next(excs)), lambda: 5)
+        assert len(sleeps) == 2   # two retries, then the re-raise
+
+    def test_success_records_completion_on_coordinator(self):
+        """r10 review fix: a finishing host durably marks itself DONE so
+        a peer restarting after this host exits fails its restore
+        barrier fast instead of waiting out the gather timeout."""
+        events = []
+
+        class _Coord:
+            def begin_attempt(self):
+                events.append("begin")
+
+            def record_failure(self, e, step=None):
+                events.append("fail")
+
+            def record_completion(self, step=None):
+                events.append("done")
+
+        sup = Supervisor(max_restarts=2, backoff_base=0.0,
+                         sleep=lambda _s: None, log=lambda *_: None,
+                         coordinator=_Coord())
+        flaky = iter([RuntimeError("once")])
+        assert sup.run(lambda i: ("ok" if next(flaky, None) is None
+                                  else (_ for _ in ()).throw(
+                                      RuntimeError("once"))),
+                       lambda: 1) == "ok"
+        assert events == ["begin", "fail", "begin", "done"]
+
+    def test_progress_none_twice_same_type_is_deterministic(self):
+        """r10 satellite fix: two failures with progress() None (neither
+        attempt completed a step) compare like any repeated step — the
+        run cannot even start, and replaying is futile."""
+        sup, sleeps = self._supervisor(max_restarts=10)
+        with pytest.raises(RuntimeError, match="init"):
+            sup.run(lambda i: (_ for _ in ()).throw(RuntimeError("init")),
+                    lambda: None)
+        assert len(sleeps) == 1   # one retry, then the re-raise
 
     def test_bounded_restarts(self):
         sup, sleeps = self._supervisor(max_restarts=2, backoff_cap=0.3)
@@ -283,6 +393,19 @@ class TestGoodput:
             g.add("not_a_segment", 1.0)
         with pytest.raises(KeyError):
             g.count("not_a_counter")
+
+    def test_mttr_excludes_pre_restart_resume_restore(self):
+        """r10 review fix: the restore a resumed run STARTS from is
+        startup, not recovery — only restore time after the first
+        restart feeds the restart_mttr_s headline."""
+        g = GoodputTracker().start()
+        g.add("restore_s", 5.0)          # --resume startup restore
+        g.count("restarts")              # then one crash
+        g.add("restart_backoff_s", 1.0)
+        g.add("restore_s", 0.5)          # the recovery restore
+        s = g.summary()
+        assert s["restart_mttr_s"] == 1.5          # NOT (5.0+0.5+1.0)/1
+        assert s["restore_s"] == 5.5               # total still accounted
 
     def test_metrics_surface(self):
         from faster_distributed_training_tpu.train.metrics import (
