@@ -118,6 +118,15 @@ ATTN_ROUTE_BENCH_CELLS = ((512, 128, ("dense", "flash")),
                           (1024, 256, ("flash",)),
                           (256, 384, ("dense", "flash")))
 
+# r11 sequence-parallel route cells: full NGD train steps at the long-
+# context cells the 4-impl surface serves — flash on the 1D mesh (the
+# single-chip-replicated alternative) vs ring/ulysses over a
+# (dp=1, sp=all-chips) mesh.  Measured N>=5 interleaved with
+# *_noise_band_pct (FDT_BENCH_ATTN2D gate in main()); the matching
+# kernel-level ladder arms are attn_fwdbwd_ms_L*_{ring,ulysses}.
+ATTN_ROUTE_SP_BENCH_CELLS = ((8, 2048, ("flash", "ring", "ulysses")),
+                             (4, 4096, ("flash", "ring", "ulysses")))
+
 
 def _fence(metrics) -> None:
     # fence with a device->host readback — on some PJRT backends
@@ -251,7 +260,15 @@ def timed_transformer(bs: int, seq: int, steps: int,
         compiled_memory_bytes)
 
     enable_compilation_cache()
-    mesh = make_mesh(("dp",))
+    mesh_spec = os.environ.get("FDT_BENCH_TF_MESH", "")
+    if mesh_spec:
+        # 2D arms (route2d_* children): e.g. "dp=1,sp=8" for the
+        # sequence-parallel route cells — axis aliases canonicalized
+        from faster_distributed_training_tpu.config import parse_mesh
+        maxes, mshape = parse_mesh(mesh_spec)
+        mesh = make_mesh(maxes, mshape)
+    else:
+        mesh = make_mesh(("dp",))
     opt = os.environ.get("FDT_BENCH_TF_OPT", "ngd")
     from faster_distributed_training_tpu.config import resolve_tricks
     cfg = resolve_tricks(TrainConfig(
@@ -272,8 +289,17 @@ def timed_transformer(bs: int, seq: int, steps: int,
     tx, _ = build_optimizer(cfg, steps_per_epoch=steps)
     state = create_train_state(model, tx, sample, rng,
                                init_kwargs={"train": True})
+    # model-axis meshes (the 2D route arms) pin the step's output state
+    # to the placement policy, mirroring run_training — without it XLA
+    # drifts params across the model axis between donated steps
+    from faster_distributed_training_tpu.parallel.mesh import (sp_size,
+                                                               tp_size)
+    from faster_distributed_training_tpu.parallel.placement import (
+        train_state_shardings)
+    shardings = (train_state_shardings(state, mesh, cfg)
+                 if tp_size(mesh) > 1 or sp_size(mesh) > 1 else None)
     with mesh:
-        state = shard_train_state(state, mesh, cfg)
+        state = shard_train_state(state, mesh, cfg, shardings=shardings)
         put = make_put_batch(mesh)
         rr = np.random.default_rng(1)
         lens = rr.integers(seq // 2, seq + 1, size=(bs,))
@@ -283,7 +309,7 @@ def timed_transformer(bs: int, seq: int, steps: int,
             "mask": (np.arange(seq)[None, :] < lens[:, None]).astype(np.int32),
             "label": rr.integers(0, 4, size=(bs,)).astype(np.int32),
         })
-        step = jax.jit(make_train_step(cfg), donate_argnums=0)
+        step = jax.jit(make_train_step(cfg, shardings), donate_argnums=0)
         compiled = step.lower(state, batch).compile()
         out = {"bs": bs, "seq": seq, "remat": remat}
         if remat:
@@ -379,12 +405,18 @@ def timed_gemm_ceiling(bs: int, seq: int, steps: int = 30) -> dict:
             "gemm_ceiling_tflops": round(mf * steps / elapsed / 1e12, 1)}
 
 
-def timed_attention_ladder(steps: int = 30) -> dict:
-    """Long-context single-chip ladder (VERDICT r2 #8: promoted from
-    PARITY prose into the bench JSON).  fwd+bwd flash attention, bf16,
-    D=64, H=8, token count held at 16k (B·L = 16384), padding mask —
-    the exact hand-run configuration behind PARITY.md's envelope row.
-    Returns {"attn_fwdbwd_ms_L{L}": ms, ...}."""
+def timed_attention_ladder(steps: int = 30, impl: str = "flash") -> dict:
+    """Long-context ladder (VERDICT r2 #8: promoted from PARITY prose
+    into the bench JSON).  fwd+bwd attention, bf16, D=64, H=8, token
+    count held at 16k (B·L = 16384), padding mask — the exact hand-run
+    configuration behind PARITY.md's envelope row.
+
+    impl "flash" (default) is the single-chip kernel; "ring"/"ulysses"
+    (r11) run the sequence-parallel strategies over a (dp=1, sp=all-
+    chips) mesh at the SAME global shapes — the multi-chip side of the
+    4-impl routing surface.  Returns {"attn_fwdbwd_ms_L{L}": ms, ...}
+    (suffix "_ring"/"_ulysses" for the sp variants); cells the chip
+    count cannot serve (L or H not divisible) are omitted, not faked."""
     import jax
     import jax.numpy as jnp
 
@@ -392,8 +424,24 @@ def timed_attention_ladder(steps: int = 30) -> dict:
         flash_attention)
 
     H, D, tokens = 8, 64, 16384
+    sp_fn, mesh, n = None, None, 1
+    if impl != "flash":
+        from faster_distributed_training_tpu.ops.ring_attention import (
+            ring_self_attention)
+        from faster_distributed_training_tpu.ops.ulysses_attention import (
+            ulysses_self_attention)
+        from faster_distributed_training_tpu.parallel import make_mesh
+        n = jax.device_count()
+        if n < 2:
+            return {}
+        mesh = make_mesh(("dp", "sp"), (1, n))
+        sp_fn = (ring_self_attention if impl == "ring"
+                 else ulysses_self_attention)
     out = {}
+    suffix = "" if impl == "flash" else f"_{impl}"
     for L in (2048, 4096, 8192, 16384):
+        if impl != "flash" and (L % n or (impl == "ulysses" and H % n)):
+            continue
         B = max(tokens // L, 1)
         rr = np.random.default_rng(L)
         q, k, v = (jnp.asarray(rr.normal(size=(B, H, L, D)), jnp.bfloat16)
@@ -402,10 +450,15 @@ def timed_attention_ladder(steps: int = 30) -> dict:
         mask = jnp.asarray(
             (np.arange(L)[None, :] < lens[:, None]).astype(np.int32))
 
-        def loss(q_, k_, v_):
-            return jnp.sum(
-                flash_attention(q_, k_, v_, mask=mask).astype(jnp.float32)
-                ** 2)
+        if impl == "flash":
+            def loss(q_, k_, v_):
+                return jnp.sum(
+                    flash_attention(q_, k_, v_,
+                                    mask=mask).astype(jnp.float32) ** 2)
+        else:
+            def loss(q_, k_, v_):
+                return jnp.sum(
+                    sp_fn(q_, k_, v_, mask, mesh).astype(jnp.float32) ** 2)
 
         step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
         g = step(q, k, v)
@@ -414,7 +467,7 @@ def timed_attention_ladder(steps: int = 30) -> dict:
         for _ in range(steps):
             g = step(q, k, v)
         jax.block_until_ready(g)
-        out[f"attn_fwdbwd_ms_L{L}"] = round(
+        out[f"attn_fwdbwd_ms_L{L}{suffix}"] = round(
             (time.monotonic() - t0) / steps * 1e3, 2)
     return out
 
@@ -1027,6 +1080,39 @@ def main() -> None:
     if child == "attn_ladder":
         print(json.dumps(timed_attention_ladder()))
         return
+    if child.startswith("attn_ladder_"):
+        # r11: sequence-parallel ladder variant (ring | ulysses)
+        print(json.dumps(timed_attention_ladder(
+            impl=child[len("attn_ladder_"):])))
+        return
+    if child.startswith("route2d_"):
+        # r11 sequence-parallel route cell: one impl at one long-context
+        # cell; ring/ulysses run over a (dp=1, sp=all-chips) mesh, the
+        # flash baseline over a dp mesh capped so the small batch still
+        # divides it.  Cells this host's chip count cannot serve (seq or
+        # heads not divisible — same guards as the ladder) report
+        # {"skipped": ...} instead of crashing the child.
+        import math as _math
+
+        import jax as _jax
+        _, cbs, cseq, impl = child.split("_")
+        cbs, cseq = int(cbs), int(cseq)
+        n_dev = _jax.device_count()
+        os.environ["FDT_BENCH_TF_ATTN"] = impl
+        if impl in ("ring", "ulysses"):
+            if (n_dev < 2 or cseq % n_dev
+                    or (impl == "ulysses" and 8 % n_dev)):
+                print(json.dumps(
+                    {"skipped": f"{impl} at bs{cbs}/seq{cseq}: "
+                                f"{n_dev} chips can't serve the cell "
+                                f"(seq/heads divisibility)"}))
+                return
+            os.environ["FDT_BENCH_TF_MESH"] = f"dp=1,sp={n_dev}"
+        else:
+            os.environ["FDT_BENCH_TF_MESH"] = f"dp={_math.gcd(cbs, n_dev)}"
+        rsteps = int(os.environ.get("FDT_BENCH_ROUTE_STEPS", "10"))
+        print(json.dumps(timed_transformer(cbs, cseq, rsteps)))
+        return
     if child.startswith("gemm_"):
         _, cbs, cseq = child.split("_")
         print(json.dumps(timed_gemm_ceiling(int(cbs), int(cseq))))
@@ -1415,6 +1501,60 @@ def main() -> None:
             ladder = _run_child("attn_ladder")
             if ladder:
                 record.update(ladder)
+        # r11 2D-mesh attention arms: the ring/ulysses ladder variants
+        # plus the sequence-parallel route cells (flash vs ring vs
+        # ulysses as full NGD train steps), N>=5 INTERLEAVED re-runs —
+        # medians published, observed range beside them as
+        # *_noise_band_pct feeding the guard thresholds (the r6 noise
+        # protocol).  These arms are what lets `_ATTN_ROUTE_SURFACE`'s
+        # sp rows claim their cells with a measurement.  Opt out with
+        # FDT_BENCH_ATTN2D=0; single-device hosts skip (nothing to
+        # shard over) and say so in-record.
+        if os.environ.get("FDT_BENCH_ATTN2D", "1") != "0":
+            if jax.device_count() < 2:
+                record["attn2d_note"] = (
+                    "ring/ulysses ladder + route cells skipped: single-"
+                    "device host (the sp strategies need >=2 chips)")
+            else:
+                reps2 = max(1, int(os.environ.get(
+                    "FDT_BENCH_ATTN2D_REPEATS", "5")))
+                rsteps2 = int(os.environ.get("FDT_BENCH_ROUTE_STEPS",
+                                             "10"))
+                lad_runs = {"ring": [], "ulysses": []}
+                route2d_runs = {}
+                for _ in range(reps2):
+                    for impl in ("ring", "ulysses"):
+                        r = _run_child(f"attn_ladder_{impl}")
+                        if r:
+                            lad_runs[impl].append(r)
+                    for cbs, cseq, impls in ATTN_ROUTE_SP_BENCH_CELLS:
+                        for impl in impls:
+                            r = _run_child(f"route2d_{cbs}_{cseq}_{impl}")
+                            if r and "elapsed" in r:
+                                route2d_runs.setdefault(
+                                    (cbs, cseq, impl), []).append(
+                                    r["elapsed"] / rsteps2 * 1e3)
+                            elif r and r.get("skipped"):
+                                # no silent caps: an unservable cell is
+                                # recorded, not just absent
+                                record[f"attn_route_bs{cbs}_seq{cseq}"
+                                       f"_{impl}_note"] = r["skipped"]
+
+                def _med_band(name, ms):
+                    ms = sorted(ms)
+                    med = ms[len(ms) // 2]
+                    record[name] = round(med, 2)
+                    if len(ms) > 1 and med:
+                        record[name + "_noise_band_pct"] = round(
+                            (ms[-1] - ms[0]) / med * 100.0, 1)
+
+                for impl, runs in lad_runs.items():
+                    for k2 in sorted(set().union(
+                            *(r.keys() for r in runs)) if runs else ()):
+                        _med_band(k2, [r[k2] for r in runs if k2 in r])
+                for (cbs, cseq, impl), ms in sorted(route2d_runs.items()):
+                    _med_band(f"attn_route_bs{cbs}_seq{cseq}_{impl}"
+                              f"_step_ms", ms)
 
     # Round-over-round regression guard (VERDICT r4 #2c): compare every
     # tracked numeric metric against the previous round's record and flag
@@ -1428,6 +1568,7 @@ def main() -> None:
         # not read as vanished metrics
         full_run = (os.environ.get("FDT_BENCH_FAST") != "1"
                     and os.environ.get("FDT_BENCH_ATTN", "1") != "0"
+                    and os.environ.get("FDT_BENCH_ATTN2D", "1") != "0"
                     and os.environ.get("FDT_BENCH_ROUTE", "1") != "0"
                     and os.environ.get("FDT_BENCH_CKPT", "1") != "0"
                     and os.environ.get("FDT_BENCH_KDIS", "1") != "0")

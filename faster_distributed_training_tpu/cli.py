@@ -287,20 +287,44 @@ def apply_subset(ds, stride: int):
 # FDT_DENSE_ATTN_BUDGET_MB (0 forces flash everywhere).
 _DENSE_ATTN_BUDGET_MB = 4096
 
-# The measured 2D dense/flash crossover surface (VERDICT r5 #5).  Every
-# cell the auto-router serves cites the bench arm that measures it; the
-# r6 arms (attn_route_*) land in BENCH_LATEST.json per round under the
+# The measured attention routing surface (VERDICT r5 #5; extended to
+# the 4-impl {dense, flash, ring, ulysses} surface in r11).  Every cell
+# the auto-router serves cites the bench arm that measures it; the arms
+# (attn_route_*) land in BENCH_LATEST.json per round under the
 # regression guard, so a crossover drift shows up as a flagged move.
+#
+# Row format: (bs, seq, routed impl, bench arm, mesh condition).
+# mesh condition "" = mesh-independent (1D / no model axis); "sp" = the
+# mesh has a sequence-capable model axis (a dedicated sp axis, or tp —
+# the axis NAME doesn't change the shard_map math, so tp-axis routing
+# cites the same arms) whose size divides both heads and seq (ulysses
+# eligible); "sp_ragged" = model axis present but heads/seq don't
+# divide (ring, which accepts any axis size).
 _ATTN_ROUTE_SURFACE = (
-    # (bs, seq, routed impl, bench arm carrying the measurement)
-    (256, 256, "dense", "transformer_agnews_ex_per_sec_bs256_seq256"),
-    (512, 128, "dense", "attn_route_bs512_seq128_dense_step_ms"),
-    (1024, 128, "dense", "attn_route_bs1024_seq128_dense_step_ms"),
-    (512, 256, "dense", "attn_route_bs512_seq256_dense_step_ms"),
-    (1024, 256, "flash", "attn_route_bs1024_seq256_flash_step_ms"),
-    (256, 384, "flash", "attn_route_bs256_seq384_flash_step_ms"),
-    (64, 512, "flash", "transformer_agnews_ex_per_sec_bs64_seq512"),
+    (256, 256, "dense", "transformer_agnews_ex_per_sec_bs256_seq256", ""),
+    (512, 128, "dense", "attn_route_bs512_seq128_dense_step_ms", ""),
+    (1024, 128, "dense", "attn_route_bs1024_seq128_dense_step_ms", ""),
+    (512, 256, "dense", "attn_route_bs512_seq256_dense_step_ms", ""),
+    (1024, 256, "flash", "attn_route_bs1024_seq256_flash_step_ms", ""),
+    (256, 384, "flash", "attn_route_bs256_seq384_flash_step_ms", ""),
+    (64, 512, "flash", "transformer_agnews_ex_per_sec_bs64_seq512", ""),
+    # r11 sequence-parallel cells (bench.ATTN_ROUTE_SP_BENCH_CELLS
+    # measures flash/ring/ulysses at each; the flash arm is the
+    # single-chip-replicated alternative the sp routing must beat):
+    (8, 2048, "ulysses", "attn_route_bs8_seq2048_ulysses_step_ms", "sp"),
+    (8, 2048, "ring", "attn_route_bs8_seq2048_ring_step_ms", "sp_ragged"),
+    (4, 4096, "ulysses", "attn_route_bs4_seq4096_ulysses_step_ms", "sp"),
+    (4, 4096, "ring", "attn_route_bs4_seq4096_ring_step_ms", "sp_ragged"),
 )
+
+# Sequence length from which a (data, model) mesh's model axis routes
+# attention sequence-parallel instead of single-chip dense/flash — the
+# boundary sits at the first measured sp cell (bs8/seq2048,
+# attn_route_bs8_seq2048_* arms); below it the 1D surface still rules
+# (dense/flash are tp-compatible: dense head-shards, flash is rerouted
+# by build_model's capability fallback).  Provisional pending the first
+# live TPU record — PARITY "r6 A/B follow-up decision" step (f).
+_SEQ_PARALLEL_MIN_LEN = 2048
 
 
 def _dense_attn_fits(bs: int, seq: int, n_heads: int) -> bool:
@@ -318,10 +342,38 @@ def _dense_attn_fits(bs: int, seq: int, n_heads: int) -> bool:
     return 3 * 4 * bs * n_heads * seq * seq <= budget_mb << 20
 
 
+def _route_model_axis(cfg: TrainConfig, ax_size: int) -> Optional[str]:
+    """The sequence-parallel impl a model axis of `ax_size` can serve
+    for this shape, or None when it can't: BOTH strategies shard the
+    sequence over the axis (shard_map divisibility), so a seq_len the
+    axis doesn't divide routes back to the single-chip surface instead
+    of an impl that would fail at trace time.  Among the eligible:
+    ulysses when the axis also divides the heads (lower interconnect
+    volume — O(B·H·L·D/sp) per tensor, collective-free inner kernel;
+    the documented trade in ops/ulysses_attention.py), ring otherwise
+    (any head count).  Per-cell attn_route_*_{ring,ulysses}_step_ms
+    arms measure both sides so the preference stays falsifiable."""
+    if cfg.seq_len % ax_size:
+        return None
+    return "ulysses" if cfg.n_heads % ax_size == 0 else "ring"
+
+
 def resolve_attention(cfg: TrainConfig, mesh=None) -> str:
-    """'' auto-resolves: ring when the mesh has an sp axis of size > 1;
-    on TPU, DENSE inside the measured 2D crossover surface and flash
-    beyond; dense off-TPU.  Explicit --attention always wins.
+    """'' auto-resolves from the measured 4-impl surface
+    {dense, flash, ring, ulysses}.  Explicit --attention always wins.
+
+    Mesh-dependent tier first (_ATTN_ROUTE_SURFACE's "sp"/"sp_ragged"
+    rows): a dedicated sp axis routes sequence-parallel whenever it can
+    serve the shape (_route_model_axis: seq must divide the axis —
+    both strategies shard L over it; ulysses when the heads divide too,
+    else ring — r6 routed a blanket "ring" here; the split is now
+    measured per cell by the attn_route_bs8_seq2048_* / bs4_seq4096_*
+    arm triples); a tp axis routes sequence-parallel only from
+    _SEQ_PARALLEL_MIN_LEN up (below it the model axis serves tensor
+    parallelism and the 1D surface rules).  Shapes the model axis
+    can't serve fall through to the mesh-independent 2D dense/flash
+    crossover: on TPU, DENSE inside the measured envelope and flash
+    beyond; dense off-TPU.
 
     The 2D surface (r5 + r6 bench arms, v5e, NGD full step):
 
@@ -349,13 +401,24 @@ def resolve_attention(cfg: TrainConfig, mesh=None) -> str:
         the boundary cell between the measured 256 and 512 points.
 
     The surface is recorded row-by-row in _ATTN_ROUTE_SURFACE (cell ->
-    impl -> measuring arm) and tests/test_substrate.py asserts every
-    routed cell's arm actually exists in bench.py."""
+    impl -> measuring arm -> mesh condition) and tests/test_substrate.py
+    asserts every routed cell's arm actually exists in bench.py."""
     if cfg.attention:
         return cfg.attention
-    if (mesh is not None and "sp" in mesh.axis_names
-            and mesh.shape["sp"] > 1):
-        return "ring"
+    from faster_distributed_training_tpu.parallel.mesh import (
+        seq_parallel_axis)
+    # route against the axis the model will EXECUTE over
+    # (seq_parallel_axis prefers a dedicated sp axis over tp — the same
+    # policy build_model hands the model as sp_axis), never against a
+    # different axis than the one shard_map will shard L on
+    ax, ax_size = seq_parallel_axis(mesh)
+    if ax is not None and (ax == "sp"
+                           or cfg.seq_len >= _SEQ_PARALLEL_MIN_LEN):
+        impl = _route_model_axis(cfg, ax_size)
+        if impl:
+            return impl
+        # seq doesn't divide the executing axis: fall through to the
+        # single-chip surface rather than crash inside shard_map
     import jax
     if jax.default_backend() != "tpu":
         return "dense"
@@ -375,7 +438,33 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
     dtype = jnp.bfloat16 if cfg.precision == "bf16" else jnp.float32
     tricks_off = cfg.tricks == "off"
     if cfg.model == "transformer":
+        from faster_distributed_training_tpu.parallel.mesh import (
+            seq_parallel_axis, tp_size)
         impl = resolve_attention(cfg, mesh)
+        tp = tp_size(mesh)
+        sp_axis, sp_ax_size = seq_parallel_axis(mesh)
+        if impl == "flash" and tp > 1:
+            # capability fallback, not a routing decision: the flash
+            # Pallas kernel is a custom call XLA's partitioner cannot
+            # split over the model axis (it would gather full q/k/v per
+            # layer, silently defeating tp) — the shard_map sequence-
+            # parallel strategies keep attention model-parallel with
+            # jnp-only collectives, so flash reroutes to them on tp
+            # meshes (explicit --attention flash included)
+            # validate against the axis the model will execute over
+            # (sp_ax_size — seq_parallel_axis prefers sp), not tp
+            fallback = _route_model_axis(cfg, sp_ax_size) or "dense"
+            import warnings
+            warnings.warn(
+                f"attention 'flash' cannot partition over the tp axis "
+                f"(Pallas custom call); using '{fallback}' "
+                + ("sequence-parallel attention over tp"
+                   if fallback != "dense" else
+                   "attention (seq_len doesn't divide the tp axis, so "
+                   "the sequence-parallel strategies can't serve it "
+                   "either)")
+                + f" on this {dict(mesh.shape)} mesh", stacklevel=2)
+            impl = fallback
         mlp_impl = cfg.mlp_impl or (
             "pallas" if jax.default_backend() == "tpu" else "fused")
         if mlp_impl == "pallas" and jax.default_backend() != "tpu":
@@ -423,13 +512,21 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                     "INTERPRET mode (orders of magnitude slower) — "
                     "test-only; use the default flax FFN for real "
                     "off-TPU runs", stacklevel=2)
+        # the model sees the mesh whenever it has work to do with it:
+        # sequence-parallel attention, the sharded fused-FFN kernel, or
+        # a model axis to annotate activations over (tp/sp activation
+        # constraints, models/transformer.py).  Pure-dp meshes pass
+        # None so the 1D program stays byte-identical to r10.
+        model_mesh = (mesh if (impl in ("ring", "ulysses")
+                               or ffn_impl == "pallas"
+                               or tp > 1 or sp_ax_size > 1) else None)
         return get_model("transformer", cfg.num_classes,
                          vocab=vocab_size or 30522, maxlen=cfg.seq_len,
                          n_layers=cfg.n_layers, d_model=cfg.d_model,
                          d_ff=cfg.d_ff, h=cfg.n_heads,
                          attention_impl=impl, mlp_impl=mlp_impl,
-                         mesh=mesh if (impl in ("ring", "ulysses")
-                                       or ffn_impl == "pallas") else None,
+                         mesh=model_mesh,
+                         sp_axis=sp_axis or "sp",
                          alpha=cfg.alpha if cfg.alpha > 0 else 0.99,
                          dtype=dtype, remat=cfg.remat,
                          remat_policy=cfg.remat_policy,
@@ -569,8 +666,18 @@ def run_training(cfg: TrainConfig,
     state = create_train_state(model, tx, sample, rng,
                                init_kwargs={"train": True},
                                extra_params=extra)
+    # the explicit sharding tree is needed beyond --host_offload on any
+    # mesh with a model axis: the train step pins its OUTPUT state to it
+    # (steps.make_train_step) so XLA's partitioner can neither drift the
+    # updated tp-sharded params toward replication nor scatter
+    # replicated params onto the sp axis between donated steps
+    # (measured: an sp mesh without the pin re-sharded pos_embedding
+    # over sp after step 1 and the donated recall mismatched)
+    from faster_distributed_training_tpu.parallel.mesh import (sp_size,
+                                                               tp_size)
     shardings = (train_state_shardings(state, mesh, cfg)
-                 if cfg.host_offload else None)
+                 if cfg.host_offload or tp_size(mesh) > 1
+                 or sp_size(mesh) > 1 else None)
     state = shard_train_state(state, mesh, cfg, shardings=shardings)
 
     # TRAIN augmentation lives inside the train step now (steps.py):
@@ -672,7 +779,15 @@ def run_training(cfg: TrainConfig,
                           put_eval_batch=put_eval, log=log,
                           state_shardings=shardings, resilience=res,
                           put_stacked=put_stacked, resident=resident)
+
+        # restored states (host numpy) must land back on the run's
+        # sharding policy — placement.place_on_shardings, shared with
+        # the loop's auto-recover rollback
+        from faster_distributed_training_tpu.parallel.placement import (
+            place_on_shardings)
+
         state, start_epoch = trainer.maybe_resume(state, ckpt_name)
+        state = place_on_shardings(state, shardings)
 
         def attempt(restart_index: int):
             """One training attempt: resume from the newest VALID
@@ -694,6 +809,7 @@ def run_training(cfg: TrainConfig,
                 got = res.manager.restore_latest(st)
                 if got is not None:
                     st, meta = got
+                    st = place_on_shardings(st, shardings)
                     ep = int(meta.get("epoch", 0))
                     sie = int(meta.get("step_in_epoch", 0))
                     trainer.best_acc = float(meta.get("best_acc",

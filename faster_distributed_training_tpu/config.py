@@ -282,7 +282,10 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--device", default=d.device, choices=["auto", "tpu", "cpu"])
     p.add_argument("--precision", default=d.precision, choices=["bf16", "fp32", "fp16"])
     p.add_argument("--mesh", default="", type=str,
-                   help="mesh as axis=size pairs, e.g. 'dp=4,fsdp=2' (default: all dp)")
+                   help="mesh as axis=size pairs, e.g. 'dp=4,tp=2' (a 2D "
+                        "(data, model) mesh) or 'dp=4,fsdp=2'; axis "
+                        "aliases: model/mp=tp, seq/context=sp (default: "
+                        "all devices on dp)")
     p.add_argument("--fsdp", action="store_true", help="fully-shard params/opt state")
     p.add_argument("--zero1", action="store_true",
                    help="shard only optimizer state over the data axes "
@@ -383,10 +386,12 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--n_heads", default=d.n_heads, type=int)
     p.add_argument("--attention", default=d.attention,
                    choices=["", "dense", "flash", "ring", "ulysses"],
-                   help="attention impl ('' = ring when the mesh has an sp "
-                        "axis, flash on TPU, else dense; ulysses = "
-                        "all-to-all sequence parallelism, needs heads %% sp "
-                        "== 0)")
+                   help="attention impl ('' = the measured 4-impl routing "
+                        "surface, cli.resolve_attention: sequence-parallel "
+                        "ulysses/ring on a model axis (sp always; tp from "
+                        "seq 2048 up — ulysses when the axis divides heads "
+                        "and seq, else ring), dense/flash per the 2D "
+                        "crossover otherwise)")
     p.add_argument("--mlp_impl", default=d.mlp_impl,
                    choices=["", "fused", "pallas"],
                    help="classifier MLP kernel ('' = pallas on TPU, else "
@@ -418,9 +423,15 @@ def build_parser(prog: str = "fdt",
 
 
 def parse_mesh(spec: str) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
-    """'dp=4,fsdp=2' -> (('dp','fsdp'), (4,2)).  Empty -> ((), ())."""
+    """'dp=4,tp=2' -> (('dp','tp'), (4,2)).  Empty -> ((), ()).
+
+    Axis names are canonicalized through parallel.mesh.AXIS_ALIASES
+    ('model'/'mp' -> 'tp', 'seq'/'context' -> 'sp', ...) so every layer
+    downstream — TP rules, attention routing, shard_map fallbacks —
+    sees one spelling per role."""
     if not spec:
         return (), ()
+    from faster_distributed_training_tpu.parallel.mesh import canonical_axes
     axes, sizes = [], []
     for part in spec.split(","):
         name, _, size = part.partition("=")
@@ -429,7 +440,7 @@ def parse_mesh(spec: str) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
             raise ValueError(f"bad mesh spec {spec!r}; want 'axis=size,...'")
         axes.append(name)
         sizes.append(int(size))
-    return tuple(axes), tuple(sizes)
+    return canonical_axes(axes), tuple(sizes)
 
 
 def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] = None,

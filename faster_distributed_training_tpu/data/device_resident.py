@@ -211,21 +211,33 @@ class ShardedDeviceResidentData:
             raise ValueError(f"global batch {self.batch_size} not divisible "
                              f"by the mesh's {d} data-axis devices")
         real_pc = jax.process_count()
-        if d % real_pc:
-            # the per-process contiguous row slice below only lines up
-            # with the row sharding when the data axis spreads evenly
-            # over processes; a tp-heavy mesh (dp_size < process_count
-            # or not a multiple) would silently mis-shard sample rows
-            raise ValueError(
-                f"sharded device residency needs the mesh's data-axis "
-                f"device count ({d}) to be a multiple of the process "
-                f"count ({real_pc}); use --resident_layout replicated "
-                f"(single-host) or the host data path for this mesh")
+        # row shards come from the DP SUBMESH only — batch_spec(mesh)
+        # shards the sample axis over dp/fsdp and REPLICATES across
+        # tp/sp (a tp group shares its rows), so a 2D (data, model)
+        # mesh keeps the n/pc per-host HBM win wherever the dp devices
+        # spread evenly over processes.  Only when dp genuinely doesn't
+        # divide the process count (a tp-heavy mesh, e.g. dp=1,tp=8 on
+        # 2 hosts — the contiguous per-process row slice can't line up
+        # with the dp sharding) do rows fall back to replicated storage,
+        # loudly: the re-shard/gather machinery is unchanged, only the
+        # per-host HBM saving is lost (r9's hard reject, relaxed r11).
+        self._rows_replicated = bool(d % real_pc)
+        if self._rows_replicated:
+            import warnings
+            warnings.warn(
+                f"sharded device residency: the mesh's data-axis device "
+                f"count ({d}) is not a multiple of the process count "
+                f"({real_pc}) — a tp-heavy mesh; row storage falls back "
+                f"to REPLICATED (per-host HBM = full split, not "
+                f"n/process_count).  Give the mesh a dp axis that "
+                f"spreads over processes to regain sharded residency",
+                stacklevel=2)
         self._n_pad = -(-self.n // d) * d
-        self._row_sharding = NamedSharding(mesh, batch_spec(mesh))
+        self._replicated = NamedSharding(mesh, P())
+        self._row_sharding = (self._replicated if self._rows_replicated
+                              else NamedSharding(mesh, batch_spec(mesh)))
         self._batch_sharding = NamedSharding(mesh,
                                              P(None, *batch_spec(mesh)))
-        self._replicated = NamedSharding(mesh, P())
         self.nbytes = 0          # HOST-LOCAL bytes resident in this
         self.arrays: Dict[str, jax.Array] = {}   # process's HBM shard
         # _encode_split's full-split host arrays are an O(n) transient
@@ -236,7 +248,15 @@ class ShardedDeviceResidentData:
         # a second full-split copy; everything here is freed on return.
         real_pi = jax.process_index()
         for k, v in host.items():
-            if real_pc > 1:
+            if self._rows_replicated:
+                if self._n_pad != self.n:
+                    v = np.concatenate(
+                        [v, np.zeros((self._n_pad - self.n,) + v.shape[1:],
+                                     v.dtype)])
+                self.arrays[k] = self._put_replicated(
+                    np.ascontiguousarray(v))
+                self.nbytes += v.nbytes
+            elif real_pc > 1:
                 rows = self._n_pad // real_pc
                 lo, hi = real_pi * rows, (real_pi + 1) * rows
                 local = v[min(lo, self.n):min(hi, self.n)]

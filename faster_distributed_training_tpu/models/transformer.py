@@ -39,6 +39,10 @@ from faster_distributed_training_tpu.ops.dropout import FastDropout
 from faster_distributed_training_tpu.ops.fused_mlp import (fused_mlp,
                                                            fused_mlp_pallas,
                                                            mlp_reference)
+from faster_distributed_training_tpu.parallel.mesh import (seq_parallel_axis,
+                                                           tp_size)
+from faster_distributed_training_tpu.parallel.sharding import (
+    mesh_data_axes, shard_activation)
 
 Dtype = Any
 NEG_INF = -1e9  # proper masking constant (reference bug: -1e-9)
@@ -189,6 +193,17 @@ class MultiheadAttention(nn.Module):
                  train: bool) -> jax.Array:
         B, L, _ = x.shape
         d_k = self.d_model // self.h
+        # projection-boundary annotations for a (data, model) mesh
+        # (SNIPPETS [3]): heads over tp through the dense attention
+        # math, the out-proj input sharded on its contiguous-head
+        # d_model grouping so the tp-sharded `out` kernel contracts
+        # locally and XLA inserts exactly one psum.  The kernel impls
+        # (flash/ring/ulysses) own their layouts — flash never meets a
+        # tp mesh (build_model reroutes it) and the sp strategies
+        # re-shard inside shard_map — so only dense is annotated.
+        dat = mesh_data_axes(self.mesh)
+        head_tp = (tp_size(self.mesh) > 1
+                   and self.attention_impl == "dense")
         if self.fused_qkv:
             qkv = nn.DenseGeneral((3, self.h, d_k), axis=-1,
                                   kernel_init=qkv_xavier, dtype=self.dtype,
@@ -204,6 +219,10 @@ class MultiheadAttention(nn.Module):
                              name=name)(x)
                 return y.reshape(B, L, self.h, d_k).transpose(0, 2, 1, 3)
             q, k, v = proj("query"), proj("key"), proj("value")
+        if head_tp:
+            q = shard_activation(q, self.mesh, (dat, "tp", None, None))
+            k = shard_activation(k, self.mesh, (dat, "tp", None, None))
+            v = shard_activation(v, self.mesh, (dat, "tp", None, None))
         # training-path prob dropout for the never-materialized impls:
         # one fresh u32 hash seed per step from the dropout rng stream
         # dropout_impl "none" disables the attention-prob regularizer on
@@ -259,7 +278,14 @@ class MultiheadAttention(nn.Module):
             rng = (self.make_rng("dropout") if drop_rate > 0 else None)
             ctx = dense_attention(q, k, v, mask, drop_rate,
                                   deterministic=not train, dropout_rng=rng)
+        if head_tp:
+            ctx = shard_activation(ctx, self.mesh, (dat, "tp", None, None))
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, L, self.d_model)
+        if head_tp:
+            # d_model here is h contiguous head groups: sharding it on
+            # tp keeps the tp-row-sharded `out` kernel's contraction
+            # local (one psum after, no activation gather before)
+            ctx = shard_activation(ctx, self.mesh, (dat, None, "tp"))
         # Name the attention context so the "attn_out" remat policy can
         # SAVE it: backward under that policy replays the cheap layer
         # matmuls (qkv/out-proj/FFN) but never re-runs the attention
@@ -273,19 +299,29 @@ class MultiheadAttention(nn.Module):
 
 
 class PositionalWiseFFN(nn.Module):
-    """transformer.py:159-177 — Linear → GELU → dropout → Linear."""
+    """transformer.py:159-177 — Linear → GELU → dropout → Linear.
+
+    On a (data, model) mesh the [B, L, d_ff] hidden is annotated sharded
+    on tp right at the first-matmul boundary, matching the tp-sharded
+    kernels (_TP_RULES: dense_0 column- / dense_1 row-sharded) so XLA
+    never gathers the full hidden activation — GELU + dropout run on
+    1/tp of it per device and the single psum lands after dense_1."""
     d_model: int
     d_ff: int
     dropout: float = 0.1
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
     dropout_impl: str = "hash"
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
         kw = dict(kernel_init=xavier_uniform, dtype=self.dtype,
                   param_dtype=self.param_dtype)
         h = nn.Dense(self.d_ff, **kw)(x)
+        if tp_size(self.mesh) > 1:
+            h = shard_activation(h, self.mesh,
+                                 (mesh_data_axes(self.mesh), None, "tp"))
         h = nn.gelu(h, approximate=False)
         h = FastDropout(self.dropout, self.dropout_impl)(
             h, deterministic=not train)
@@ -363,6 +399,22 @@ class EncoderLayer(nn.Module):
                  train: bool) -> jax.Array:
         ln = lambda name: TorchLayerNorm(   # noqa: E731
             dtype=self.dtype, param_dtype=self.param_dtype, name=name)
+        # sequence-parallel LN/dropout regions (Korthikanti et al.;
+        # ops/sequence_parallel.py owns the kernel-side analog): between
+        # the parallel blocks the residual stream is annotated sharded
+        # on the model axis ALONG THE SEQUENCE — LayerNorm (per-token
+        # over D) and the connection dropouts (position-hashed) run on
+        # L/ax tokens per device and the per-device activation residing
+        # between TP regions shrinks by 1/ax.  XLA inserts the gather
+        # exactly at the qkv/FFN entry (or hands the already-sequence-
+        # sharded tensor straight to ring/ulysses' shard_map).  Identity
+        # on 1D meshes (shard_activation filters absent axes).
+        seq_ax, _ = seq_parallel_axis(self.mesh)
+        dat = mesh_data_axes(self.mesh)
+        seq_shard = (
+            (lambda x: shard_activation(x, self.mesh, (dat, seq_ax, None)))
+            if seq_ax is not None else (lambda x: x))
+        h = seq_shard(h)
         a = ln("ln_attn")(h)
         a = MultiheadAttention(self.h, self.d_model, self.dropout_attention,
                                self.dtype, self.param_dtype,
@@ -372,8 +424,9 @@ class EncoderLayer(nn.Module):
                                flash_save_stats=self.flash_save_stats,
                                name="attn")(a, mask, train)
         a = FastDropout(self.dropout_connection_attention,
-                        self.dropout_impl)(a, deterministic=not train)
-        h = h + a
+                        self.dropout_impl)(seq_shard(a),
+                                           deterministic=not train)
+        h = seq_shard(h + a)
         # ADVICE r5 (medium): the kernel's in-VMEM dropout IS the hash
         # engine — it must follow dropout_impl like every other site.
         # "none" (the all-dropout-off floor switch) runs the kernel with
@@ -428,10 +481,11 @@ class EncoderLayer(nn.Module):
                    if self.remat_ffn else PositionalWiseFFN)
         f = ffn_cls(self.d_model, self.d_ff, self.dropout_ffn,
                     self.dtype, self.param_dtype,
-                    self.dropout_impl, name="ffn")(f, train)
+                    self.dropout_impl, self.mesh, name="ffn")(f, train)
         f = FastDropout(self.dropout_connection_ffn,
-                        self.dropout_impl)(f, deterministic=not train)
-        return h + f
+                        self.dropout_impl)(seq_shard(f),
+                                           deterministic=not train)
+        return seq_shard(h + f)
 
 
 class Transformer(nn.Module):

@@ -50,7 +50,11 @@ def sp_self_attention(body: Callable, q: jax.Array, k: jax.Array,
     B, H, L, D = q.shape
     batch = batch_axes(mesh)
     lead = batch if len(batch) != 1 else batch[0]
-    tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
+    # head-parallelism inside sequence-parallelism — UNLESS the tp axis
+    # IS the sequence axis (a 2D (dp, tp) mesh running ring/ulysses over
+    # tp, r11): one mesh axis cannot shard both heads and sequence
+    tp = (mesh.shape["tp"]
+          if "tp" in mesh.axis_names and sp_axis != "tp" else 1)
     head = ("tp" if tp > 1 and H % tp == 0
             and (H // tp) % heads_per_shard_divisor == 0 else None)
     qkv_spec = P(lead, head, sp_axis, None)

@@ -8,15 +8,24 @@ compiler from the shardings rather than hooked into backward like DDP.
 """
 
 from faster_distributed_training_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_ALIASES,
     MeshSpec,
+    axis_size,
+    canonical_axes,
+    canonical_axis,
     make_mesh,
     initialize_distributed,
     local_batch_slice,
+    seq_parallel_axis,
+    sp_size,
+    tp_size,
 )
 from faster_distributed_training_tpu.parallel.sharding import (  # noqa: F401
     batch_spec,
     replicated,
     fsdp_partition_params,
+    mesh_data_axes,
+    shard_activation,
     shard_pytree,
     tensor_parallel_rules,
 )

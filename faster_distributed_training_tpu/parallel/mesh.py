@@ -12,6 +12,13 @@ Axis naming convention used framework-wide:
            ZeRO-3; XLA turns grad psum into reduce_scatter + all_gather)
   "tp"   — tensor parallel (attention heads / MLP hidden sharded)
   "sp"   — sequence/context parallel (ring attention, ops/ring_attention.py)
+
+AXIS_ALIASES is the ONE canonical alias table (r11 satellite): every
+surface that names a mesh axis — ``--mesh`` parsing, ``resolve_attention``
+auto-routing, ``apply_tp_rules``, the shard_map fallbacks in
+``build_model`` — goes through ``canonical_axis`` so ``--mesh
+dp=4,model=2`` and ``--mesh dp=4,tp=2`` are the same mesh and no layer
+can disagree about what the model axis is called.
 """
 
 from __future__ import annotations
@@ -24,6 +31,68 @@ from typing import Optional, Sequence, Tuple
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+# canonical-name ← accepted spellings.  Unknown names pass through
+# unchanged (exotic axes stay usable), but the four canonical roles each
+# accept the common alternative spellings, so the TP rules (which match
+# the LITERAL string "tp") and the sequence-parallel ops (literal "sp")
+# always see the canonical name regardless of what the CLI was given.
+AXIS_ALIASES = {
+    "dp": "dp", "data": "dp", "batch": "dp",
+    "fsdp": "fsdp", "zero": "fsdp", "zero3": "fsdp",
+    "tp": "tp", "model": "tp", "mp": "tp", "tensor": "tp",
+    "sp": "sp", "seq": "sp", "sequence": "sp", "context": "sp",
+}
+
+# ICI speed rank for the auto device-assignment policy: higher = placed
+# on a faster (more-minor) mesh axis.  Model/sequence axes carry the
+# per-layer collectives (psum at every FFN/projection boundary, the
+# ring's per-step ppermute), data axes one grad psum per step — so tp
+# gets the fastest links, dp the slowest (DCN on multi-slice pods).
+_AXIS_SPEED = {"dp": 0, "fsdp": 1, "sp": 2, "tp": 3}
+
+
+def canonical_axis(name: str) -> str:
+    """Canonical spelling of a mesh-axis name (AXIS_ALIASES)."""
+    return AXIS_ALIASES.get(str(name).strip().lower(), str(name).strip())
+
+
+def canonical_axes(axes: Sequence[str]) -> Tuple[str, ...]:
+    out = tuple(canonical_axis(a) for a in axes)
+    if len(set(out)) != len(out):
+        raise ValueError(f"mesh axes {tuple(axes)} collapse to duplicate "
+                         f"canonical names {out} (see AXIS_ALIASES)")
+    return out
+
+
+def axis_size(mesh: Optional[Mesh], name: str) -> int:
+    """Size of canonical axis `name` in `mesh` (1 when absent/None)."""
+    if mesh is None:
+        return 1
+    name = canonical_axis(name)
+    return int(mesh.shape[name]) if name in mesh.axis_names else 1
+
+
+def tp_size(mesh: Optional[Mesh]) -> int:
+    return axis_size(mesh, "tp")
+
+
+def sp_size(mesh: Optional[Mesh]) -> int:
+    return axis_size(mesh, "sp")
+
+
+def seq_parallel_axis(mesh: Optional[Mesh]) -> Tuple[Optional[str], int]:
+    """(axis_name, size) the sequence-parallel ops (ring/ulysses) and the
+    sequence-sharded activation regions should use: a dedicated "sp"
+    axis when present at size > 1, else the "tp" axis (Megatron-style
+    sequence parallelism rides the tensor-parallel group), else
+    (None, 1).  The ONE policy resolve_attention, build_model and the
+    model's activation annotations all share."""
+    for name in ("sp", "tp"):
+        n = axis_size(mesh, name)
+        if n > 1:
+            return name, n
+    return None, 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,10 +126,66 @@ def initialize_distributed(coordinator: Optional[str] = None,
                                    process_id=process_id)
 
 
+def _ici_device_mesh(shape: Tuple[int, ...],
+                     axes: Tuple[str, ...]) -> Optional[np.ndarray]:
+    """ICI-aware device assignment for a TPU mesh (SNIPPETS [1]).
+
+    `mesh_utils.create_device_mesh` assigns later mesh dims to
+    physically nearer chips, so the axes are permuted SLOWEST-first by
+    `_AXIS_SPEED` (dp outermost, tp innermost = fastest links) before
+    construction and transposed back to the caller's order after — the
+    "tp on the fastest axis" auto policy.  Multi-process pods factor the
+    slowest data axis over DCN via `create_hybrid_device_mesh`.  Returns
+    None when the topology tools can't serve the request (caller falls
+    back to the plain reshape)."""
+    try:
+        from jax.experimental import mesh_utils
+    except ImportError:        # pragma: no cover - jax always ships it
+        return None
+    perm = sorted(range(len(axes)),
+                  key=lambda i: (_AXIS_SPEED.get(axes[i], -1), i))
+    pshape = tuple(shape[i] for i in perm)
+    try:
+        pc = jax.process_count()
+        if pc > 1:
+            # factor the process count out of the slowest DATA axis that
+            # divides it — that axis spans slices over DCN, everything
+            # else stays inside a slice's ICI.  Only dp/fsdp are
+            # eligible: letting tp/sp span DCN would put the per-layer
+            # model-parallel collectives on the slowest links, inverting
+            # the _AXIS_SPEED policy — a mesh whose data axes can't
+            # absorb the process count falls back to the plain reshape.
+            paxes = [axes[i] for i in perm]
+            dcn = [1] * len(pshape)
+            for j, d in enumerate(pshape):
+                if paxes[j] in ("dp", "fsdp") and d % pc == 0 and d >= pc:
+                    dcn[j] = pc
+                    break
+            else:
+                return None
+            ici = list(pshape)
+            ici[j] //= pc
+            dev = mesh_utils.create_hybrid_device_mesh(
+                tuple(ici), tuple(dcn))
+        else:
+            dev = mesh_utils.create_device_mesh(pshape)
+    except Exception:
+        return None
+    return np.transpose(dev, np.argsort(perm))
+
+
 def make_mesh(axes: Sequence[str] = ("dp",),
               shape: Sequence[int] = (),
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build a Mesh. Empty `shape` auto-sizes: one unsized axis absorbs all devices.
+
+    Axis names are canonicalized through AXIS_ALIASES (``--mesh
+    dp=4,model=2`` == ``dp=4,tp=2``).  On TPU with default devices the
+    device assignment is ICI-aware (`_ici_device_mesh`: tp on the
+    fastest links, hybrid ICI×DCN on pods — SNIPPETS [1]); everywhere
+    else (CPU simulation, explicit device lists) it is the plain
+    row-major reshape, whose LAST axis is still the fastest-varying —
+    so ``dp=4,tp=2`` groups tp pairs on adjacent devices either way.
 
     Single-process only: a shape smaller than the visible device count
     uses the FIRST prod(shape) devices — the CUDA_VISIBLE_DEVICES-
@@ -71,12 +196,13 @@ def make_mesh(axes: Sequence[str] = ("dp",),
 
     Examples:
       make_mesh()                          -> all devices on "dp"
-      make_mesh(("dp","tp"), (2, 4))       -> 2x4 mesh
+      make_mesh(("dp","tp"), (4, 2))       -> 4x2 (data, model) mesh
       make_mesh(("fsdp",))                 -> all devices fully-sharded
     """
+    explicit_devices = devices is not None
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    axes = tuple(axes)
+    axes = canonical_axes(axes)
     if not shape:
         shape = (n,) + (1,) * (len(axes) - 1)
     shape = tuple(shape)
@@ -93,7 +219,12 @@ def make_mesh(axes: Sequence[str] = ("dp",),
         warnings.warn(f"mesh shape {shape} uses {want} of {n} visible "
                       f"devices; the remaining {n - want} idle",
                       stacklevel=2)
-    dev_array = np.asarray(devices[:want]).reshape(shape)
+    dev_array = None
+    if (not explicit_devices and want == n
+            and devices[0].platform == "tpu"):
+        dev_array = _ici_device_mesh(shape, axes)
+    if dev_array is None:
+        dev_array = np.asarray(devices[:want]).reshape(shape)
     return Mesh(dev_array, axes)
 
 

@@ -99,6 +99,18 @@ def _supports_memory_kind(mesh: Mesh) -> bool:
         return False
 
 
+def place_on_shardings(state, shardings):
+    """Re-place a (possibly host-numpy, e.g. checkpoint-restored) state
+    onto an explicit sharding tree — identity when `shardings` is None.
+    The ONE re-placement policy every restore path shares (run_training
+    resume/attempt, the auto-recover rollback), so a 2D mesh's
+    tp-sharded params always land back on their shards instead of
+    wherever jit's default placement puts uncommitted arrays."""
+    if shardings is None:
+        return state
+    return jax.tree.map(jax.device_put, state, shardings)
+
+
 def shard_train_state(state, mesh: Mesh, cfg: TrainConfig, shardings=None):
     """device_put the full state per the DP/FSDP/offload policy.  Offload
     applies only to params/opt_state (the big leaves).  Pass `shardings`

@@ -26,8 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def batch_spec(mesh: Mesh, *extra_axes: Optional[str]) -> P:
     """PartitionSpec for a [batch, ...] array: batch over every data-ish mesh axis."""
-    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names
-                      and mesh.shape[a] > 1)
+    data_axes = mesh_data_axes(mesh)
     if not data_axes:
         data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)[:1]
     lead = data_axes if len(data_axes) != 1 else data_axes[0]
@@ -36,6 +35,46 @@ def batch_spec(mesh: Mesh, *extra_axes: Optional[str]) -> P:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def mesh_data_axes(mesh: Optional[Mesh]) -> tuple:
+    """The mesh's data axes with size > 1 (batch-sharding candidates)."""
+    if mesh is None:
+        return ()
+    return tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names
+                 and mesh.shape[a] > 1)
+
+
+def shard_activation(x, mesh: Optional[Mesh], dims: Sequence) -> Any:
+    """`with_sharding_constraint(x, P(*dims))`, defensively filtered.
+
+    `dims` has one entry per array dim: None, an axis name, or a tuple
+    of axis names.  Axes the mesh doesn't have (or has at size 1) are
+    dropped, as is any dim annotation whose axis sizes don't divide the
+    dim — so the SAME model code is a no-op on a 1D dp mesh and a real
+    constraint on a (data, model) mesh (SNIPPETS [3]'s `with_sharding`
+    pattern).  Semantically always the identity: it only constrains
+    XLA's partitioner, never the values."""
+    if mesh is None:
+        return x
+    spec, any_axis = [], False
+    for i, d in enumerate(dims):
+        if d is None:
+            spec.append(None)
+            continue
+        names = (d,) if isinstance(d, str) else tuple(d)
+        names = tuple(a for a in names if a in mesh.axis_names
+                      and mesh.shape[a] > 1)
+        total = int(np.prod([mesh.shape[a] for a in names])) if names else 1
+        if not names or x.shape[i] % total:
+            spec.append(None)
+            continue
+        spec.append(names if len(names) > 1 else names[0])
+        any_axis = True
+    if not any_axis:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec)))
 
 
 def _largest_divisible_axis(shape: Sequence[int], n: int) -> Optional[int]:

@@ -522,6 +522,12 @@ class Trainer:
                         f"a row (epoch {epoch}); giving up")
                 state, ck_epoch, _ = ckpt.restore_checkpoint(
                     cfg.checkpoint_dir, recover_name, state)
+                # 2D/offload policies: put the restored (host numpy)
+                # leaves back on their shards instead of letting the
+                # next jit place uncommitted arrays
+                from faster_distributed_training_tpu.parallel.placement \
+                    import place_on_shardings
+                state = place_on_shardings(state, self._state_shardings)
                 # rollback moved state.step — re-anchor the host mirror
                 self.global_step = int(jax.device_get(state.step))
                 self.log(f"[recover] non-finite loss at epoch {epoch}; "

@@ -89,6 +89,12 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
     fp16 = cfg.precision == "fp16"
     is_text = cfg.model == "transformer"
     mode = resolve_mixup_mode(cfg)
+    # non-offload shardings (a tp/2D mesh): pin the UPDATED state to the
+    # placement policy — without the constraint XLA's propagation is
+    # free to replicate the optimizer update's outputs, silently undoing
+    # the 1/tp per-param footprint the sharding exists for.  Offload
+    # runs pin through stash() instead (different memory kinds).
+    constrain_out = state_shardings is not None and not cfg.host_offload
     if cfg.host_offload and state_shardings is None:
         # the placement layer pins params/opt state to pinned_host for this
         # cfg; a step without the fetch would compile against host-placed
@@ -203,6 +209,9 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
                    "total": jnp.asarray(y.shape[0], jnp.float32)}
         if fp16:
             metrics["loss_scale"] = updated.loss_scale.scale
+        if constrain_out:
+            updated = jax.tree.map(jax.lax.with_sharding_constraint,
+                                   updated, state_shardings)
         return stash(updated), metrics
 
     return step

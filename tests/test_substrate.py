@@ -209,10 +209,47 @@ def test_resolve_attention_seq_length_routing(monkeypatch, devices8):
     monkeypatch.delenv("FDT_DENSE_ATTN_BUDGET_MB")
     assert resolve_attention(TrainConfig(seq_len=512,
                                          attention="dense")) == "dense"
+    # r11 4-impl surface: a dedicated sp axis routes sequence-parallel —
+    # ulysses when the axis divides heads AND seq (lower interconnect
+    # volume, the measured-arm-backed preference), ring otherwise
     sp_mesh = make_mesh(("dp", "sp"), (1, 8), devices8)
-    assert resolve_attention(TrainConfig(seq_len=2048), sp_mesh) == "ring"
+    assert resolve_attention(TrainConfig(seq_len=2048), sp_mesh) == "ulysses"
+    assert resolve_attention(
+        TrainConfig(seq_len=2048, n_heads=6), sp_mesh) == "ring"
+    # seq % sp != 0: NEITHER sp strategy can serve it (shard_map needs
+    # the sequence to divide the axis) — falls through to the 1D
+    # surface instead of routing an impl that would fail at trace time
+    assert resolve_attention(
+        TrainConfig(seq_len=2050), sp_mesh) == "flash"
+    # a (data, model) tp mesh goes sequence-parallel only from the first
+    # measured long-context cell up; below it the 1D surface rules
+    tp_mesh = make_mesh(("dp", "tp"), (4, 2), devices8)
+    assert resolve_attention(TrainConfig(seq_len=2048), tp_mesh) == "ulysses"
+    assert resolve_attention(
+        TrainConfig(seq_len=2048, n_heads=7), tp_mesh) == "ring"
+    assert resolve_attention(
+        TrainConfig(seq_len=2049), tp_mesh) == "flash"   # seq % tp != 0
+    assert resolve_attention(
+        TrainConfig(seq_len=256, batch_size=256), tp_mesh) == "dense"
+    assert resolve_attention(
+        TrainConfig(seq_len=512, batch_size=256), tp_mesh) == "flash"
+    # mixed sp+tp mesh: divisibility must be validated against the axis
+    # the model will EXECUTE over (seq_parallel_axis prefers sp) — seq
+    # 2050 divides tp=2 but not sp=4, and routing it by the tp check
+    # would crash shard_map at trace time over the sp axis
+    mix_mesh = make_mesh(("dp", "sp", "tp"), (1, 4, 2), devices8)
+    assert resolve_attention(TrainConfig(seq_len=2050), mix_mesh) == "flash"
+    assert resolve_attention(TrainConfig(seq_len=2048),
+                             mix_mesh) == "ulysses"
+    # axis ALIAS unification (r11 satellite): '--mesh dp=4,model=2'
+    # builds a canonical tp axis, so routing can't miss it by name
+    alias_mesh = make_mesh(("dp", "model"), (4, 2), devices8)
+    assert "tp" in alias_mesh.axis_names
+    assert resolve_attention(TrainConfig(seq_len=2048),
+                             alias_mesh) == "ulysses"
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     assert resolve_attention(TrainConfig(seq_len=512)) == "dense"
+    assert resolve_attention(TrainConfig(seq_len=512), tp_mesh) == "dense"
 
 
 def test_attn_route_surface_cells_cite_measured_arms():
@@ -225,8 +262,7 @@ def test_attn_route_surface_cells_cite_measured_arms():
     import os as _os
     import re as _re
 
-    from faster_distributed_training_tpu.cli import (_ATTN_ROUTE_SURFACE,
-                                                     _dense_attn_fits)
+    from faster_distributed_training_tpu.cli import _ATTN_ROUTE_SURFACE
 
     here = _os.path.join(_os.path.dirname(__file__), "..")
     spec = importlib.util.spec_from_file_location(
@@ -237,14 +273,15 @@ def test_attn_route_surface_cells_cite_measured_arms():
         latest = _json.load(fh)
 
     assert _ATTN_ROUTE_SURFACE, "routing surface must not be empty"
-    for bs, seq, impl, arm in _ATTN_ROUTE_SURFACE:
+    cell = {c[:2]: c[2] for c in (bench.ATTN_ROUTE_BENCH_CELLS
+                                  + bench.ATTN_ROUTE_SP_BENCH_CELLS)}
+    for bs, seq, impl, arm, cond in _ATTN_ROUTE_SURFACE:
         if arm.startswith("attn_route_"):
             m = _re.match(r"attn_route_bs(\d+)_seq(\d+)_(\w+?)_step_ms$",
                           arm)
             assert m, arm
             abs_, aseq, aimpl = int(m.group(1)), int(m.group(2)), m.group(3)
             assert (abs_, aseq) == (bs, seq), (arm, bs, seq)
-            cell = {c[:2]: c[2] for c in bench.ATTN_ROUTE_BENCH_CELLS}
             assert (bs, seq) in cell, f"{arm}: no bench arm for cell"
             assert aimpl in cell[(bs, seq)], f"{arm}: impl not measured"
         else:
@@ -252,9 +289,35 @@ def test_attn_route_surface_cells_cite_measured_arms():
             assert arm in latest, f"{arm} not in BENCH_LATEST.json"
         # the surface's impl must agree with what resolve_attention's
         # rule actually returns for the cell (table and code in sync)
-        expect = ("dense" if seq <= 256 and _dense_attn_fits(bs, seq, 8)
-                  else "flash")
-        assert impl == expect, (bs, seq, impl, expect)
+        assert impl == expect_route(bs, seq, cond), (bs, seq, impl, cond)
+
+
+def expect_route(bs, seq, cond):
+    """What resolve_attention's code actually returns for a surface row
+    — evaluated through the REAL function with a mesh matching the
+    row's condition, so the table cannot drift from the rule."""
+    import jax
+
+    from faster_distributed_training_tpu.cli import resolve_attention
+    from faster_distributed_training_tpu.config import TrainConfig
+    from faster_distributed_training_tpu.parallel import make_mesh
+
+    if cond == "":
+        # mesh-independent rows are the r6 TPU dense/flash crossover
+        from unittest import mock
+        with mock.patch.object(jax, "default_backend", lambda: "tpu"):
+            return resolve_attention(
+                TrainConfig(seq_len=seq, batch_size=bs))
+    # sp rows: an 8-way sequence-capable axis; "sp" = divisible heads
+    # (default h=8), "sp_ragged" = heads the axis doesn't divide
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("sp surface rows need an 8-device mesh, host "
+                    f"exposes {len(jax.devices())}")
+    mesh = make_mesh(("dp", "sp"), (1, 8), jax.devices()[:8])
+    heads = 8 if cond == "sp" else 6
+    return resolve_attention(
+        TrainConfig(seq_len=seq, batch_size=bs, n_heads=heads), mesh)
 
 
 def test_ffn_impl_pallas_mesh_routing(devices8):
