@@ -772,13 +772,49 @@ def run_training(cfg: TrainConfig,
                 f"donation stays on (ROADMAP retest satisfied; if this "
                 f"run segfaults post-restore, re-open the workaround)")
 
+    # -- telemetry (r12): every run emits the structured surface bench.py
+    # used to monopolize — per-dispatch JSONL + manifest + span breakdown
+    # + (pods) the epoch straggler fold.  build_telemetry returns None
+    # under --no_telemetry / FDT_TELEMETRY=0 and the hot loop gets zero
+    # new work.
+    from faster_distributed_training_tpu.telemetry import (
+        build_telemetry, resolve_telemetry_dir, spans, write_manifest)
+    from faster_distributed_training_tpu.utils.profiling import (
+        StepWindowProfiler, parse_profile_steps)
+
     ckpt_name = "transformer" if is_text else "resnet"
+    telemetry = build_telemetry(cfg, log=log)
+    prev_span_recorder = None
+    if telemetry is not None:
+        prev_span_recorder = spans.set_recorder(telemetry.recorder)
+        if telemetry.pi == 0:
+            write_manifest(telemetry.directory, cfg, mesh,
+                           extra={"steps_per_epoch": steps_per_epoch,
+                                  "workload": ckpt_name})
+        if res is not None:
+            # restart/preemption/peer-failure counters land in the
+            # stream as they happen (goodput.set_event_sink)
+            res.goodput.set_event_sink(telemetry.recorder
+                                       .goodput_event_sink)
+        log(f"[telemetry] recording to {telemetry.directory} "
+            f"(host {telemetry.pi}/{telemetry.pc}; disable with "
+            f"--no_telemetry or FDT_TELEMETRY=0)")
+    profiler = None
+    window = parse_profile_steps(cfg.profile_steps)
+    if window is not None:
+        trace_dir = os.path.join(resolve_telemetry_dir(cfg),
+                                 f"trace_steps_{window[0]}_{window[1]}")
+        profiler = StepWindowProfiler(trace_dir, *window, log=log)
+        log(f"[profile] windowed capture armed: global steps "
+            f"{window[0]}..{window[1]} -> {trace_dir}")
+
     preempted = False
     with mesh:
         trainer = Trainer(cfg, put_batch=put_train,
                           put_eval_batch=put_eval, log=log,
                           state_shardings=shardings, resilience=res,
-                          put_stacked=put_stacked, resident=resident)
+                          put_stacked=put_stacked, resident=resident,
+                          telemetry=telemetry, profiler=profiler)
 
         # restored states (host numpy) must land back on the run's
         # sharding policy — placement.place_on_shardings, shared with
@@ -866,6 +902,14 @@ def run_training(cfg: TrainConfig,
                 # swallowed Ctrl-C or a thread still writing checkpoints
                 if res is not None:
                     res.close()
+                if profiler is not None:
+                    profiler.close()   # an open window is still captured
+                if telemetry is not None:
+                    # flush the tail, refresh pod_summary.json, and give
+                    # the process-global span sink back (a crashed run's
+                    # telemetry is exactly the telemetry worth keeping)
+                    telemetry.close()
+                    spans.set_recorder(prev_span_recorder)
 
     if cfg.plot and jax.process_index() == 0 and trainer.history["test_acc"]:
         prefix = ckpt_name
@@ -875,6 +919,8 @@ def run_training(cfg: TrainConfig,
                    f"{prefix} epoch time", f"{prefix}_time.png")
     out = {"state": state, "history": trainer.history,
            "best_acc": trainer.best_acc, "cfg": cfg}
+    if telemetry is not None:
+        out["telemetry_dir"] = telemetry.directory
     if res is not None:
         out["preempted"] = preempted
         attach_goodput(out, res.goodput)

@@ -161,7 +161,34 @@ class TrainConfig:
                                       # resnet50_test.py:560-566, at 1/N the
                                       # sync cost; 0 disables)
     profile: bool = False
+    profile_steps: str = ""           # "A:B": start/stop jax.profiler
+                                      # around global train steps A..B
+                                      # (1-indexed, inclusive) MID-RUN —
+                                      # the whole-run --profile is
+                                      # unusable past toy scale.  Trace
+                                      # lands under the telemetry dir
+                                      # (utils/profiling.py
+                                      # StepWindowProfiler)
     plot: bool = True
+
+    # -- telemetry (telemetry/ package; on by default, <1% guarded) -------
+    telemetry: bool = True            # per-dispatch JSONL records + run
+                                      # manifest + span breakdown under
+                                      # <checkpoint_dir>/telemetry (or
+                                      # --telemetry_dir); process 0 folds
+                                      # per-host files into pod p50/p95/
+                                      # p99 + straggler flags per epoch.
+                                      # Kill switches: --no_telemetry,
+                                      # FDT_TELEMETRY=0; overhead guarded
+                                      # <1% by bench telemetry_overhead_pct
+    telemetry_dir: str = ""           # "" = <checkpoint_dir>/telemetry
+                                      # (pods share it like the ckpt fs —
+                                      # the aggregation transport needs a
+                                      # shared directory)
+    straggler_ratio: float = 2.0      # flag a host whose per-step p95
+                                      # exceeds this multiple of the pod
+                                      # median host-p95 (the [telemetry]
+                                      # straggler line)
 
     # -- failure detection / debugging ------------------------------------
     # The reference has neither (SURVEY.md §5: recovery = manual re-launch
@@ -306,6 +333,25 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--seed", default=d.seed, type=int)
     p.add_argument("--checkpoint_dir", default=d.checkpoint_dir, type=str)
     p.add_argument("--profile", action="store_true", help="capture a jax.profiler trace")
+    p.add_argument("--profile_steps", default=d.profile_steps, type=str,
+                   help="capture a jax.profiler trace around global train "
+                        "steps A:B only (1-indexed, inclusive; quantized "
+                        "to dispatch boundaries under --steps_per_dispatch)"
+                        " — the mid-run window --profile can't give")
+    p.add_argument("--no_telemetry", action="store_true",
+                   help="disable run telemetry (per-dispatch JSONL + "
+                        "manifest + pod straggler aggregation under "
+                        "<checkpoint_dir>/telemetry); FDT_TELEMETRY=0 "
+                        "is the env equivalent")
+    p.add_argument("--telemetry_dir", default=d.telemetry_dir, type=str,
+                   help="telemetry output directory (default "
+                        "<checkpoint_dir>/telemetry; pods must share it, "
+                        "like the checkpoint fs)")
+    p.add_argument("--straggler_ratio", default=d.straggler_ratio,
+                   type=float,
+                   help="flag a host whose per-step p95 exceeds this "
+                        "multiple of the pod median host-p95 in the "
+                        "epoch [telemetry] line")
     p.add_argument("--log_every", default=d.log_every, type=int,
                    help="live loss/acc/throughput line every N train steps "
                         "(0 disables; the reference's tqdm descriptors, "
@@ -460,6 +506,10 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         remat=args.remat, remat_policy=args.remat_policy,
         data_dir=args.data_dir, subset_stride=args.subset_stride, seed=args.seed,
         checkpoint_dir=args.checkpoint_dir, profile=args.profile,
+        profile_steps=args.profile_steps,
+        telemetry=not args.no_telemetry,
+        telemetry_dir=args.telemetry_dir,
+        straggler_ratio=args.straggler_ratio,
         log_every=args.log_every,
         plot=not args.no_plot,
         auto_recover=args.auto_recover, debug=args.debug,

@@ -59,6 +59,7 @@ import numpy as np
 from faster_distributed_training_tpu.data.loader import (dataset_len,
                                                          pod_epoch_order,
                                                          shard_for_host)
+from faster_distributed_training_tpu.telemetry import spans
 
 
 def _encode_split(data, max_len: int) -> Tuple[Dict[str, np.ndarray],
@@ -115,8 +116,9 @@ class DeviceResidentData:
             from jax.sharding import NamedSharding, PartitionSpec
             self._replicated = NamedSharding(mesh, PartitionSpec())
         self.nbytes = sum(a.nbytes for a in host.values())
-        self.arrays: Dict[str, jax.Array] = {
-            k: self._put(v) for k, v in host.items()}
+        with spans.span("h2d_upload"):
+            self.arrays: Dict[str, jax.Array] = {
+                k: self._put(v) for k, v in host.items()}
 
     def _put(self, arr: np.ndarray) -> jax.Array:
         if self._replicated is not None:
@@ -137,7 +139,11 @@ class DeviceResidentData:
         idx = shard_for_host(self.n, epoch, self.seed, self.shuffle,
                              process_index=0, process_count=1)
         idx = idx[: self.steps_per_epoch * self.batch_size]
-        return self._put(np.ascontiguousarray(idx.astype(np.int32)))
+        # the replicated layout's only per-epoch device work — spanned
+        # under the same name as the sharded re-shard so the telemetry
+        # breakdown compares the two layouts' epoch-boundary cost
+        with spans.span("epoch_reshard"):
+            return self._put(np.ascontiguousarray(idx.astype(np.int32)))
 
 
 class ShardedDeviceResidentData:
@@ -247,34 +253,37 @@ class ShardedDeviceResidentData:
         # applied to the LOCAL slice only, so no host ever materializes
         # a second full-split copy; everything here is freed on return.
         real_pi = jax.process_index()
-        for k, v in host.items():
-            if self._rows_replicated:
-                if self._n_pad != self.n:
-                    v = np.concatenate(
-                        [v, np.zeros((self._n_pad - self.n,) + v.shape[1:],
-                                     v.dtype)])
-                self.arrays[k] = self._put_replicated(
-                    np.ascontiguousarray(v))
-                self.nbytes += v.nbytes
-            elif real_pc > 1:
-                rows = self._n_pad // real_pc
-                lo, hi = real_pi * rows, (real_pi + 1) * rows
-                local = v[min(lo, self.n):min(hi, self.n)]
-                if hi > self.n:   # this host's slice covers pad rows
-                    local = np.concatenate(
-                        [local, np.zeros((hi - max(lo, self.n),)
+        with spans.span("h2d_upload"):
+            for k, v in host.items():
+                if self._rows_replicated:
+                    if self._n_pad != self.n:
+                        v = np.concatenate(
+                            [v, np.zeros((self._n_pad - self.n,)
                                          + v.shape[1:], v.dtype)])
-                self.arrays[k] = jax.make_array_from_process_local_data(
-                    self._row_sharding, np.ascontiguousarray(local))
-                self.nbytes += local.nbytes
-            else:
-                if self._n_pad != self.n:
-                    v = np.concatenate(
-                        [v, np.zeros((self._n_pad - self.n,) + v.shape[1:],
-                                     v.dtype)])
-                self.arrays[k] = jax.device_put(np.ascontiguousarray(v),
-                                                self._row_sharding)
-                self.nbytes += v.nbytes
+                    self.arrays[k] = self._put_replicated(
+                        np.ascontiguousarray(v))
+                    self.nbytes += v.nbytes
+                elif real_pc > 1:
+                    rows = self._n_pad // real_pc
+                    lo, hi = real_pi * rows, (real_pi + 1) * rows
+                    local = v[min(lo, self.n):min(hi, self.n)]
+                    if hi > self.n:   # this host's slice covers pad rows
+                        local = np.concatenate(
+                            [local, np.zeros((hi - max(lo, self.n),)
+                                             + v.shape[1:], v.dtype)])
+                    self.arrays[k] = \
+                        jax.make_array_from_process_local_data(
+                            self._row_sharding,
+                            np.ascontiguousarray(local))
+                    self.nbytes += local.nbytes
+                else:
+                    if self._n_pad != self.n:
+                        v = np.concatenate(
+                            [v, np.zeros((self._n_pad - self.n,)
+                                         + v.shape[1:], v.dtype)])
+                    self.arrays[k] = jax.device_put(
+                        np.ascontiguousarray(v), self._row_sharding)
+                    self.nbytes += v.nbytes
         self._reshard = None
         self._epoch_cache: Tuple[Optional[int], Optional[dict],
                                  Optional[jax.Array]] = (None, None, None)
@@ -326,7 +335,8 @@ class ShardedDeviceResidentData:
             self._reshard = jax.jit(
                 fn, out_shardings={k: self._batch_sharding
                                    for k in self.arrays})
-        view = self._reshard(self.arrays, order)
+        with spans.span("epoch_reshard"):
+            view = self._reshard(self.arrays, order)
         self._epoch_cache = (epoch, view, order)
         return view
 

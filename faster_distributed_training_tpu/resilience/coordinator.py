@@ -425,6 +425,15 @@ class PodCoordinator:
 
     def gather_restored_step(self, step: int,
                              phase: str = "agree") -> np.ndarray:
+        """Span-wrapped ("rendezvous" — barrier waits are the pod
+        restore's dominant cost and telemetry must attribute them):
+        see :meth:`_gather_restored_step_impl`."""
+        from faster_distributed_training_tpu.telemetry import spans
+        with spans.span("rendezvous"):
+            return self._gather_restored_step_impl(step, phase)
+
+    def _gather_restored_step_impl(self, step: int,
+                                   phase: str = "agree") -> np.ndarray:
         """Filesystem allgather of every host's restored checkpoint step
         (−1 = nothing restored) — the restore agreement barrier for
         fs-SIMULATED pods, where jax is single-process per host and the

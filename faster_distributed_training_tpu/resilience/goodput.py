@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import time
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 # badput wall-time segments (seconds); anything not in a segment while
 # the clock runs is counted productive.  detect_s = failure-to-observed
@@ -47,6 +47,17 @@ class GoodputTracker:
         # resume start) is not recovery work — snapshotted when the
         # first restart lands so the MTTR numerator excludes it
         self._restore_pre_restart: Optional[float] = None
+        # optional (counter, total) feed — the telemetry recorder
+        # installs itself here (r12) so restarts/preemptions/peer
+        # failures land in the run's JSONL stream AS THEY HAPPEN, not
+        # only in the epoch-end snapshot.  `steps` is excluded: it ticks
+        # every dispatch and the per-dispatch step records already carry
+        # that information.
+        self._event_sink: Optional[Callable[[str, int], None]] = None
+
+    def set_event_sink(self, sink: Optional[Callable[[str, int], None]]
+                       ) -> None:
+        self._event_sink = sink
 
     def start(self) -> "GoodputTracker":
         if self._t0 is None:
@@ -66,6 +77,11 @@ class GoodputTracker:
         if counter == "restarts" and self._restore_pre_restart is None:
             self._restore_pre_restart = self._seg["restore_s"]
         self._cnt[counter] += n
+        if self._event_sink is not None and counter != "steps":
+            try:
+                self._event_sink(counter, self._cnt[counter])
+            except Exception:
+                pass  # observability must never fail accounting
 
     @contextmanager
     def timed(self, segment: str):

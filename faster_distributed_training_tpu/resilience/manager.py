@@ -53,6 +53,7 @@ from typing import Any, Callable, List, Optional, Tuple
 import jax
 import numpy as np
 
+from faster_distributed_training_tpu.telemetry import spans
 from faster_distributed_training_tpu.train import checkpoint as ckpt
 
 _STEP_DIR = re.compile(r"^(?P<prefix>.+)_step_(?P<step>\d{9})$")
@@ -204,9 +205,10 @@ class AsyncCheckpointManager:
         if sync:
             self._drain_inflight()
             t0 = time.monotonic()
-            ckpt.save_checkpoint(self.directory, name, state,
-                                 epoch=epoch, best_acc=best_acc,
-                                 extra_meta=meta)
+            with spans.span("ckpt_sync_save", step=step):
+                ckpt.save_checkpoint(self.directory, name, state,
+                                     epoch=epoch, best_acc=best_acc,
+                                     extra_meta=meta)
             self._prune()
             self._record_save(step, time.monotonic() - t0, segment)
             if self._goodput:
@@ -233,7 +235,8 @@ class AsyncCheckpointManager:
         t0 = time.monotonic()
         # the blocking part: the next train step will donate these
         # buffers, so the snapshot must complete before it dispatches
-        snapshot = jax.device_get(ckpt._state_pytree(state))
+        with spans.span("ckpt_snapshot", step=step):
+            snapshot = jax.device_get(ckpt._state_pytree(state))
         blocking = time.monotonic() - t0
         path = os.path.join(self.directory, name)
         if self._pool is None:
@@ -242,9 +245,18 @@ class AsyncCheckpointManager:
         self._inflight_path = path
         self._skip_logged = False
         self._inflight = self._pool.submit(
-            ckpt.save_pytree_checkpoint, path, snapshot, meta)
+            self._write_pytree_bg, path, snapshot, meta, step)
         self._record_save(step, blocking, segment)
         return True
+
+    @staticmethod
+    def _write_pytree_bg(path: str, snapshot, meta: dict,
+                         step: int) -> None:
+        """Background worker body of the single-host async save —
+        span-wrapped so the serialize+commit cost shows up in telemetry
+        (recorded from the writer thread; the recorder is lock-safe)."""
+        with spans.span("ckpt_commit", step=step):
+            ckpt.save_pytree_checkpoint(path, snapshot, meta)
 
     def _save_sharded(self, state, step: int, meta: dict, name: str,
                       segment: str) -> bool:
@@ -270,7 +282,8 @@ class AsyncCheckpointManager:
         self._drain_inflight()
         # blocking part: the drain above + fetching THIS process's owned
         # shards to host — the next train step donates those buffers
-        blocks = ckpt.host_shard_snapshot(state, self._shard_owner)
+        with spans.span("ckpt_snapshot", step=step):
+            blocks = ckpt.host_shard_snapshot(state, self._shard_owner)
         blocking = time.monotonic() - t0
         path = os.path.join(self.directory, name)
         if self._pool is None:
@@ -287,11 +300,12 @@ class AsyncCheckpointManager:
                                  meta: dict) -> None:
         """Background worker body: phase-1 shard write (every host),
         phase-2 barrier + COMMIT (process 0 only)."""
-        ckpt.write_host_shards(path, self._pi, blocks)
-        if self._pi == 0:
-            ckpt.commit_sharded_checkpoint(
-                path, meta, n_hosts=self._pc,
-                timeout_s=self._commit_timeout_s)
+        with spans.span("ckpt_commit", step=meta.get("step")):
+            ckpt.write_host_shards(path, self._pi, blocks)
+            if self._pi == 0:
+                ckpt.commit_sharded_checkpoint(
+                    path, meta, n_hosts=self._pc,
+                    timeout_s=self._commit_timeout_s)
 
     def _record_save(self, step: int, blocking_s: float,
                      segment: str = "checkpoint_blocking_s") -> None:
@@ -382,6 +396,13 @@ class AsyncCheckpointManager:
         return None
 
     def restore_latest(self, state) -> Optional[Tuple[Any, dict]]:
+        """Span-wrapped entry (telemetry "restore" — failed walks still
+        record their cost; that time IS the MTTR restore component):
+        see :meth:`_restore_latest_impl` for the semantics."""
+        with spans.span("restore"):
+            return self._restore_latest_impl(state)
+
+    def _restore_latest_impl(self, state) -> Optional[Tuple[Any, dict]]:
         """(restored_state, meta) from the newest checkpoint that BOTH
         carries a commit marker and actually restores — a committed-but-
         corrupt newest (bit rot, torn block device) falls back to the

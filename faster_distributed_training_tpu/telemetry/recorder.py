@@ -1,0 +1,281 @@
+"""Run-scoped telemetry: per-dispatch records + run manifest as JSONL.
+
+Every ordinary training run emits machine-readable evidence — not just
+dedicated ``bench.py`` runs: a :class:`TelemetryRecorder` buffers one
+small host-side record per dispatch (step, wall ms, examples/s,
+data-wait ms, checkpoint-blocking ms, K, epoch) plus span/epoch/goodput
+events, and a single background writer appends them as JSONL to
+``<telemetry_dir>/host_<pi>.jsonl`` — the r7 off-critical-path idiom
+(one worker thread, the step thread only appends to a list under a
+lock).  A run manifest (config, mesh, jax/jaxlib versions, device kind)
+is written once at startup (:func:`write_manifest`) so a telemetry
+directory is self-describing.
+
+Cost accounting (the ``telemetry_overhead_pct`` bench arm pins <1% of
+median step): the hot-path cost per dispatch is a few ``time.monotonic``
+reads, one dict construction, and one lock-guarded list append; JSON
+encoding and file IO happen on the background thread.  The buffer is a
+RING in spirit — bounded, never a backlog: when ``capacity`` records
+accumulate they are handed to the writer as one batch, and if the
+writer falls more than a few batches behind (a wedged filesystem) new
+batches are DROPPED and counted (``dropped_records``) rather than
+queued — observability must never grow unbounded host memory or stall
+the step loop.  ``FDT_TELEMETRY=0`` kills the whole subsystem
+(cli.build_telemetry).
+
+Schema (APPEND-ONLY — fields may be added, never renamed; consumers
+must ignore unknown fields).  One JSON object per line, discriminated
+by ``"kind"``:
+
+  ``run_start``  {t, process_index, process_count, schema}
+  ``step``       {step, epoch, n, k, wall_ms, dispatch_ms, data_ms,
+                  block_ms, examples, ex_s, compile?}
+                 step = global step AFTER the dispatch; n = step in
+                 epoch; wall_ms = full host wall since the previous
+                 record (data wait + dispatch + resilience hooks);
+                 dispatch_ms = the jitted call alone; ex_s =
+                 examples / wall; compile=true marks a first execution
+                 (compile time — aggregation excludes these from
+                 step-time percentiles)
+  ``span``       {name, dur_ms, step?}           (telemetry/spans.py)
+  ``epoch``      {epoch, steps, trained_steps, loss?, accuracy?,
+                  wall_s, ex_s, peak_mem_bytes?, eval_loss?,
+                  eval_accuracy?}
+  ``goodput``    {… GoodputTracker.summary() …}  (per-epoch snapshot)
+  ``goodput_event`` {counter, total}             (restart/preemption/
+                  peer-failure counters as they happen — the MTTR
+                  story rides the same stream)
+  ``flush_stats``  {dropped_records}             (emitted at close when
+                  any batch was dropped)
+
+Run scoping: the host file is opened in APPEND mode — a supervised
+relaunch of the same run (same checkpoint_dir) continues the same
+story, pre-crash records included.  A fresh run wants a fresh
+directory, exactly like the checkpoint dir (cli.attempt's docstring);
+the aggregation barrier is additionally time-scoped
+(telemetry/aggregate.py) so a reused directory's markers can't lie.
+
+Wall-time caveat, documented rather than hidden: per-dispatch wall time
+is HOST time between dispatch returns.  Under async dispatch the host
+can briefly run ahead of the device, but donated-buffer backpressure
+re-couples them within one step, so percentiles over an epoch track
+device step time; the bench arms remain the fenced ground truth.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+SCHEMA_VERSION = 1
+ENV_KILL = "FDT_TELEMETRY"
+MANIFEST = "manifest.json"
+
+# background-writer backlog bound (batches, not records): beyond this
+# the recorder drops instead of queueing — a wedged shared fs must not
+# grow snapshots of the run in host memory
+_MAX_PENDING_BATCHES = 4
+
+
+def _write_json_atomic(path: str, obj) -> None:
+    # local tmp+replace+fsync copy (the coordinator/checkpoint idiom) so
+    # a reader never observes a torn manifest/summary
+    tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_manifest(directory: str, cfg=None, mesh=None,
+                   extra: Optional[dict] = None) -> str:
+    """``<directory>/manifest.json``, written once at startup (process
+    0): everything needed to interpret the host JSONL files without the
+    process that wrote them — config, mesh, jax/jaxlib versions, device
+    kind/count.  Returns the path."""
+    import dataclasses
+
+    import jax
+
+    man: dict = {"schema": SCHEMA_VERSION,
+                 "unix_time": round(time.time(), 3)}
+    try:
+        import jaxlib
+        man["jaxlib_version"] = getattr(jaxlib, "__version__", "?")
+    except ImportError:
+        man["jaxlib_version"] = ""
+    man["jax_version"] = jax.__version__
+    try:
+        dev = jax.local_devices()[0]
+        man["backend"] = jax.default_backend()
+        man["device_kind"] = getattr(dev, "device_kind", str(dev))
+        man["device_count"] = jax.device_count()
+        man["process_count"] = jax.process_count()
+    except Exception:
+        pass  # an uninitializable backend must not kill the run
+    if mesh is not None:
+        try:
+            man["mesh"] = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+        except Exception:
+            man["mesh"] = str(mesh)
+    if cfg is not None:
+        man["config"] = (dataclasses.asdict(cfg)
+                         if dataclasses.is_dataclass(cfg) else dict(cfg))
+    if extra:
+        man.update(extra)
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, MANIFEST)
+    _write_json_atomic(path, man)
+    return path
+
+
+class TelemetryRecorder:
+    """Host-side ring buffer of telemetry records, flushed as JSONL off
+    the critical path (single background writer, append-mode file).
+
+    ``process_index``/``process_count`` default to the pod identity (the
+    FDT_POD_INDEX/FDT_POD_COUNT simulation seam, else the jax runtime —
+    same resolution as resilience/coordinator.py), and exist as explicit
+    arguments so tier-1 tests can run two recorders in one process as a
+    simulated two-host pod sharing a telemetry directory."""
+
+    def __init__(self, directory: str,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 capacity: int = 256,
+                 log: Callable[[str], None] = print):
+        if process_index is None or process_count is None:
+            # lazy import: resilience.coordinator imports telemetry.spans
+            # at module level, so importing it from THIS module's top
+            # would be circular
+            from faster_distributed_training_tpu.resilience.coordinator \
+                import pod_identity
+            pi, pc, _sim = pod_identity()
+            process_index = pi if process_index is None else process_index
+            process_count = pc if process_count is None else process_count
+        self.pi = int(process_index)
+        self.pc = int(process_count)
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.path = os.path.join(self.directory,
+                                 f"host_{self.pi:05d}.jsonl")
+        self.capacity = max(int(capacity), 1)
+        self._log = log
+        self._lock = threading.Lock()
+        self._buf: list = []
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pending = 0
+        self.dropped_records = 0
+        self._closed = False
+        self.record_event("run_start", t=round(time.time(), 3),
+                          process_index=self.pi, process_count=self.pc,
+                          schema=SCHEMA_VERSION)
+
+    # -- recording (hot path) ---------------------------------------------
+
+    def record_step(self, step: int, epoch: int, n: int, k: int,
+                    wall_ms: float, dispatch_ms: float, examples: int,
+                    data_ms: float = 0.0, block_ms: float = 0.0,
+                    compile_: bool = False) -> None:
+        rec = {"kind": "step", "step": int(step), "epoch": int(epoch),
+               "n": int(n), "k": int(k), "wall_ms": round(wall_ms, 3),
+               "dispatch_ms": round(dispatch_ms, 3),
+               "data_ms": round(data_ms, 3), "block_ms": round(block_ms, 3),
+               "examples": int(examples),
+               "ex_s": round(examples / max(wall_ms / 1e3, 1e-9), 1)}
+        if compile_:
+            rec["compile"] = True
+        self._append(rec)
+
+    def record_span(self, name: str, dur_ms: float,
+                    step: Optional[int] = None) -> None:
+        rec = {"kind": "span", "name": str(name),
+               "dur_ms": round(dur_ms, 3)}
+        if step is not None:
+            rec["step"] = int(step)
+        self._append(rec)
+
+    def record_event(self, kind: str, **fields) -> None:
+        self._append({"kind": str(kind), **fields})
+
+    def goodput_event_sink(self, counter: str, total: int) -> None:
+        """Adapter for ``GoodputTracker.set_event_sink`` — restart/
+        preemption/peer-failure counters land in the stream as they
+        happen, so one file tells the run's whole story."""
+        self.record_event("goodput_event", counter=str(counter),
+                          total=int(total))
+
+    def _append(self, rec: dict) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._buf.append(rec)
+            if len(self._buf) >= self.capacity:
+                self._flush_locked()
+
+    # -- flushing (background) --------------------------------------------
+
+    def _flush_locked(self, wait: bool = False):
+        if not self._buf:
+            return None
+        batch, self._buf = self._buf, []
+        if self._pending >= _MAX_PENDING_BATCHES and not wait:
+            # the writer is wedged (filesystem stall): drop, don't queue
+            self.dropped_records += len(batch)
+            return None
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=1,
+                                            thread_name_prefix="fdt-telem")
+        self._pending += 1
+        return self._pool.submit(self._write_batch, batch)
+
+    def _write_batch(self, batch: list) -> None:
+        try:
+            with open(self.path, "a") as f:
+                for rec in batch:
+                    f.write(json.dumps(rec, default=str))
+                    f.write("\n")
+        except OSError as e:
+            self.dropped_records += len(batch)
+            self._log(f"[telemetry] could not append {len(batch)} records "
+                      f"to {self.path}: {e!r}")
+        finally:
+            # under the SAME lock the step thread increments with: a
+            # bare `-= 1` is a read-modify-write that can interleave
+            # with the locked `+= 1`, and a lost decrement would drift
+            # the backlog counter up until every batch is dropped
+            with self._lock:
+                self._pending -= 1
+
+    def flush(self, wait: bool = False) -> None:
+        """Hand the current buffer to the writer; ``wait=True`` blocks
+        until it (and it alone) is on disk — epoch boundaries flush-wait
+        before publishing their aggregation marker so process 0 reads a
+        complete epoch."""
+        with self._lock:
+            fut = self._flush_locked(wait=wait)
+        if wait and fut is not None:
+            fut.result()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            if self.dropped_records:
+                self._buf.append({"kind": "flush_stats",
+                                  "dropped_records": self.dropped_records})
+            fut = self._flush_locked(wait=True)
+            self._closed = True
+        if fut is not None:
+            try:
+                fut.result()
+            except Exception:
+                pass
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None

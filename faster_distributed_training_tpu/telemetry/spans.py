@@ -1,0 +1,74 @@
+"""Span API: one name, two observability surfaces.
+
+``with spans.span("restore"):`` does two things at once:
+
+  * records the HOST wall time of the block into the active
+    :class:`~faster_distributed_training_tpu.telemetry.recorder.
+    TelemetryRecorder` (a ``{"kind": "span", ...}`` JSONL event), so
+    ordinary runs get a span breakdown without any profiler attached;
+  * wraps the block in ``jax.profiler.TraceAnnotation`` under the same
+    ``fdt/<name>`` label, so when a trace IS being captured (``--profile``
+    or the windowed ``--profile_steps A:B``) the identical names appear
+    on the XLA timeline — the JSONL numbers and the trace annotate each
+    other instead of living in two vocabularies.
+
+The recorder is installed process-globally (:func:`set_recorder`) rather
+than threaded through every constructor: the instrumented seams live in
+modules that predate telemetry (resilience/manager.py's background
+writer thread, data/device_resident.py's upload path) and must stay
+usable — at zero overhead beyond two clock reads and the trace
+annotation — when no recorder is active (bench floors, library use).
+The recorder's buffer is lock-guarded, so spans may be recorded from
+any thread (the checkpoint background writer does).
+
+Span names in use (append-only — new names may be added, existing ones
+are never renamed; README "Observability" documents them):
+
+  ``h2d_upload``            device_resident split upload (once per run)
+  ``epoch_reshard``         per-epoch order upload / batch-major re-shard
+  ``ckpt_snapshot``         blocking device->host state fetch of a save
+  ``ckpt_commit``           background serialize + two-phase commit
+  ``ckpt_sync_save``        blocking (sync/emergency) collective save
+  ``restore``               checkpoint restore walk (manager)
+  ``rendezvous``            pod restore-agreement barrier (coordinator)
+  ``eval``                  the per-epoch eval pass
+  ``first_dispatch_compile`` first execution of a train program (compile)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+_ACTIVE = None   # the installed TelemetryRecorder (or None)
+
+
+def set_recorder(recorder) -> Optional[object]:
+    """Install `recorder` as the process-global span sink; returns the
+    previously installed one so callers can restore it (tests nest)."""
+    global _ACTIVE
+    prev, _ACTIVE = _ACTIVE, recorder
+    return prev
+
+
+def get_recorder():
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def span(name: str, step: Optional[int] = None) -> Iterator[None]:
+    """Record `name`'s host wall time to the active recorder AND label
+    the same region ``fdt/<name>`` in any in-flight profiler trace.
+    Exception-safe: a span that raises still records its duration (a
+    failed restore's cost is exactly the kind of time MTTR wants)."""
+    import jax
+
+    t0 = time.monotonic()
+    try:
+        with jax.profiler.TraceAnnotation(f"fdt/{name}"):
+            yield
+    finally:
+        rec = _ACTIVE
+        if rec is not None:
+            rec.record_span(name, (time.monotonic() - t0) * 1e3, step=step)

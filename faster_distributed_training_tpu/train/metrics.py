@@ -8,12 +8,32 @@ one host sync per epoch, not per batch."""
 
 from __future__ import annotations
 
-from typing import Dict, List
+import math
+from typing import Dict, Iterable, List
 
 import jax
 import numpy as np
 
 Metrics = Dict[str, jax.Array]
+
+
+def percentiles(values: Iterable[float],
+                qs: Iterable[int] = (50, 95, 99)) -> Dict[int, float]:
+    """Nearest-rank percentiles of host floats — {q: value}, {} when
+    empty.  Shared by the telemetry aggregation (per-step p50/p95/p99,
+    telemetry/aggregate.py) and scripts/telemetry_report.py so the two
+    can never disagree on the definition.  Nearest-rank (not
+    interpolated): a reported p99 is a step time that actually
+    happened, which is what straggler forensics wants."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return {}
+    out = {}
+    for q in qs:
+        idx = max(0, min(len(vals) - 1,
+                         math.ceil(q / 100.0 * len(vals)) - 1))
+        out[int(q)] = round(vals[idx], 3)
+    return out
 
 
 class MetricAccumulator:

@@ -1,0 +1,169 @@
+"""Summarize a run's telemetry directory (r12 observability satellite).
+
+Reads the run manifest + every ``host_<pi>.jsonl`` the run emitted
+(telemetry/recorder.py) and prints the run's story in one screen:
+
+  * manifest header (workload, mesh, device kind, jax/jaxlib versions);
+  * per-host and pod step-time percentiles (p50/p95/p99 of per-step
+    dispatch time, compile records excluded — the same definition as the
+    in-run ``[telemetry]`` epoch line, telemetry/aggregate.py);
+  * the straggler table (hosts whose p95 exceeds the configured ratio
+    of the pod median host-p95);
+  * the throughput curve (per-epoch examples/s + loss from the epoch
+    events);
+  * the span breakdown (count/total/mean per span name: checkpoint
+    snapshot/commit, restore, rendezvous, eval, H2D upload, epoch
+    re-shard, first-dispatch compile);
+  * the final goodput/MTTR snapshot riding the same stream.
+
+Run:  python scripts/telemetry_report.py <telemetry_dir>
+          [--straggler_ratio 2.0] [--json]
+
+Smoke-tested (tier-1, milliseconds) against the recorded fixture
+``tests/fixtures/telemetry/`` by tests/test_telemetry.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(directory: str, straggler_ratio: float = 2.0) -> dict:
+    """The report as a dict (main() renders it; tests assert on it)."""
+    from faster_distributed_training_tpu.telemetry import (MANIFEST,
+                                                           aggregate_run,
+                                                           read_host_records,
+                                                           span_breakdown)
+
+    report: dict = {"directory": os.path.abspath(directory)}
+    man_path = os.path.join(directory, MANIFEST)
+    if os.path.exists(man_path):
+        try:
+            with open(man_path) as f:
+                report["manifest"] = json.load(f)
+        except (OSError, ValueError) as e:
+            report["manifest_error"] = repr(e)
+    report["summary"] = aggregate_run(directory,
+                                      straggler_ratio=straggler_ratio)
+    hosts = read_host_records(directory)
+    # throughput curve + goodput from host 0's stream (metrics are
+    # already pod-global: the jitted step psums them, so every host's
+    # epoch events agree — train/metrics.py)
+    lead = hosts.get(0) or (hosts[min(hosts)] if hosts else [])
+    report["throughput_curve"] = [
+        {k: r[k] for k in ("epoch", "steps", "trained_steps", "wall_s",
+                           "ex_s", "loss", "accuracy", "eval_loss",
+                           "eval_accuracy", "peak_mem_bytes") if k in r}
+        for r in lead if r.get("kind") == "epoch"]
+    goodputs = [r for r in lead if r.get("kind") == "goodput"]
+    if goodputs:
+        report["goodput"] = {k: v for k, v in goodputs[-1].items()
+                             if k != "kind"}
+    all_recs: list = []
+    for recs in hosts.values():
+        all_recs.extend(recs)
+    report["spans"] = span_breakdown(all_recs)
+    dropped = sum(r.get("dropped_records", 0) for r in all_recs
+                  if r.get("kind") == "flush_stats")
+    if dropped:
+        report["dropped_records"] = dropped
+    return report
+
+
+def _fmt_pct_row(tag: str, st: dict) -> str:
+    return (f"  {tag:<8} p50={st.get('step_ms_p50', 0):>8.2f}ms "
+            f"p95={st.get('step_ms_p95', 0):>8.2f}ms "
+            f"p99={st.get('step_ms_p99', 0):>8.2f}ms "
+            f"({st.get('steps', 0)} steps)")
+
+
+def render(report: dict) -> str:
+    lines = [f"telemetry report: {report['directory']}"]
+    man = report.get("manifest")
+    if man:
+        mesh = man.get("mesh")
+        lines.append(
+            f"  run: {man.get('workload', '?')} on "
+            f"{man.get('device_count', '?')}x "
+            f"{man.get('device_kind', '?')} ({man.get('backend', '?')}), "
+            f"mesh={mesh}, jax {man.get('jax_version', '?')} / jaxlib "
+            f"{man.get('jaxlib_version', '?')}")
+    s = report.get("summary", {})
+    pod = s.get("pod")
+    if pod:
+        lines.append("step-time percentiles (dispatch_ms / K, compile "
+                     "excluded):")
+        lines.append(_fmt_pct_row("pod", pod))
+        # numeric sort: aggregate_run stringifies host keys, and a
+        # lexicographic sort would list host 10 before host 2
+        for pi, st in sorted(s.get("hosts", {}).items(),
+                             key=lambda kv: int(kv[0])):
+            lines.append(_fmt_pct_row(f"host {pi}", st))
+    if s.get("stragglers"):
+        lines.append(f"stragglers (p95 > "
+                     f"{s.get('straggler_ratio', 2.0):.1f}x pod median "
+                     f"host-p95 {s.get('pod_median_host_p95_ms', 0):.2f}"
+                     f"ms):")
+        for st in s["stragglers"]:
+            lines.append(f"  host {st['host']}: "
+                         f"p95={st['step_ms_p95']:.2f}ms "
+                         f"({st['ratio']:.2f}x)")
+    elif s.get("host_count", 0) > 1:
+        lines.append("stragglers: none")
+    curve = report.get("throughput_curve")
+    if curve:
+        lines.append("throughput curve:")
+        for e in curve:
+            bits = [f"  epoch {e.get('epoch')}:"]
+            if "ex_s" in e:
+                bits.append(f"{e['ex_s']:.0f} ex/s")
+            if "loss" in e:
+                bits.append(f"loss={e['loss']:.4f}")
+            if "eval_accuracy" in e:
+                bits.append(f"eval_acc={e['eval_accuracy']:.4f}")
+            if "peak_mem_bytes" in e:
+                bits.append(f"peak_mem={e['peak_mem_bytes'] / 1e6:.0f}MB")
+            lines.append(" ".join(bits))
+    sp = report.get("spans")
+    if sp:
+        lines.append("span breakdown (all hosts):")
+        for name, st in sorted(sp.items(),
+                               key=lambda kv: -kv[1]["total_ms"]):
+            lines.append(f"  {name:<24} x{st['count']:<4} "
+                         f"total={st['total_ms']:>10.1f}ms "
+                         f"mean={st['mean_ms']:>8.1f}ms")
+    g = report.get("goodput")
+    if g:
+        lines.append(f"goodput: {g.get('goodput_pct', '?')}% over "
+                     f"{g.get('wall_s', '?')}s"
+                     + (f", mttr {g['restart_mttr_s']}s/restart"
+                        if g.get("restart_mttr_s") else ""))
+    if report.get("dropped_records"):
+        lines.append(f"WARNING: {report['dropped_records']} records "
+                     f"dropped (writer backlog — see recorder.py)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("directory", help="a run's telemetry directory "
+                                      "(<checkpoint_dir>/telemetry)")
+    ap.add_argument("--straggler_ratio", type=float, default=2.0)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report dict as JSON")
+    args = ap.parse_args(argv)
+    report = run(args.directory, straggler_ratio=args.straggler_ratio)
+    if args.json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(render(report))
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -485,6 +485,10 @@ class TestPrefetchClose:
         trainer.put_batch = lambda b: b
         trainer.global_step = 0
         trainer.log = lambda *_: None
+        trainer.telemetry = None             # r12 observability attrs the
+        trainer.profiler = None              # dispatch loop reads
+        trainer._blocked_since_log = 0.0
+        trainer._dispatched = set()
 
         def boom(state, batch):
             raise RuntimeError("step exploded")

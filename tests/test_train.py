@@ -677,3 +677,78 @@ class TestHostOffload:
                                    float(m_plain["loss"]), rtol=1e-6)
         # the NGD step actually updated something
         assert float(out_state.step) == 1
+
+
+class TestMetricAccumulator:
+    """Direct coverage for train/metrics.py::MetricAccumulator.summary()
+    edge cases + format_goodput pluralization (r12 satellite — the
+    epoch-loss definitions below are what the telemetry epoch events
+    and the fused-dispatch exact-loss contract both lean on)."""
+
+    def test_empty_accumulator_summary_is_empty(self):
+        from faster_distributed_training_tpu.train.metrics import (
+            MetricAccumulator)
+        acc = MetricAccumulator()
+        assert acc.summary() == {}
+
+    def test_padded_final_eval_batch_weights_loss_exactly(self):
+        """loss_total/total: the padded final eval batch (fewer valid
+        samples) must contribute by SAMPLE weight, not by batch — the
+        sample-weighted mean, not the mean of batch means."""
+        from faster_distributed_training_tpu.train.metrics import (
+            MetricAccumulator)
+        acc = MetricAccumulator()
+        # full batch: 8 samples, summed loss 8.0; padded tail: 2 valid
+        # samples, summed loss 4.0
+        acc.add({"loss_total": jnp.float32(8.0), "total": jnp.float32(8.0),
+                 "correct": jnp.float32(6.0)})
+        acc.add({"loss_total": jnp.float32(4.0), "total": jnp.float32(2.0),
+                 "correct": jnp.float32(1.0)})
+        s = acc.summary()
+        assert s["loss"] == pytest.approx(12.0 / 10.0)   # not (1.0+2.0)/2
+        assert s["accuracy"] == pytest.approx(7.0 / 10.0)
+        assert s["total_sum"] == 10.0
+
+    def test_mean_fallback_without_loss_total(self):
+        from faster_distributed_training_tpu.train.metrics import (
+            MetricAccumulator)
+        acc = MetricAccumulator()
+        acc.add({"loss": jnp.float32(1.0)})
+        acc.add({"loss": jnp.float32(3.0)})
+        s = acc.summary()
+        assert s["loss"] == pytest.approx(2.0)
+        assert s["loss_sum"] == pytest.approx(4.0)
+
+    def test_zero_total_yields_zero_accuracy_not_nan(self):
+        from faster_distributed_training_tpu.train.metrics import (
+            MetricAccumulator)
+        acc = MetricAccumulator()
+        acc.add({"correct": jnp.float32(0.0), "total": jnp.float32(0.0)})
+        s = acc.summary()
+        assert s["accuracy"] == 0.0
+        # all-padded batches also disable the loss_total path (sum 0):
+        # no ZeroDivisionError, no NaN
+        assert "loss" not in s
+
+    def test_zero_total_with_loss_total_falls_back_to_mean(self):
+        from faster_distributed_training_tpu.train.metrics import (
+            MetricAccumulator)
+        acc = MetricAccumulator()
+        acc.add({"loss_total": jnp.float32(5.0), "total": jnp.float32(0.0),
+                 "loss": jnp.float32(2.5)})
+        assert acc.summary()["loss"] == pytest.approx(2.5)
+
+    def test_format_goodput_count_pluralization(self):
+        from faster_distributed_training_tpu.resilience import (
+            GoodputTracker)
+        from faster_distributed_training_tpu.train.metrics import (
+            format_goodput)
+        g = GoodputTracker(clock=lambda: 0.0).start()
+        g.count("saves", 1)
+        g.count("restores", 2)
+        g.count("preemptions", 1)
+        line = format_goodput(g)
+        # exactly-one counters drop the trailing s; plurals keep it
+        assert "1 save," in line or line.endswith("1 save")
+        assert "2 restores" in line
+        assert "1 preemption" in line and "1 preemptions" not in line
