@@ -512,6 +512,58 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                     "INTERPRET mode (orders of magnitude slower) — "
                     "test-only; use the default flax FFN for real "
                     "off-TPU runs", stacklevel=2)
+        # --quant int8/fp8 (r13): the QuantPolicy handed to the model,
+        # with the kernel routing decided HERE where the mesh/backend
+        # are known (train.amp.resolve_quant_policy owns the cfg->fmt
+        # mapping; ops/quant.py owns the math/kernels).
+        quant = None
+        from faster_distributed_training_tpu.train.amp import (
+            resolve_quant_policy)
+        policy = resolve_quant_policy(cfg)
+        if policy is not None:
+            import warnings
+
+            from faster_distributed_training_tpu.ops.quant import (
+                quant_enabled)
+            if not quant_enabled():
+                # the kill switch leaves the param/state TREE intact
+                # (QuantDense computes the plain matmul) so a killed
+                # run's checkpoints interchange with quantized ones
+                warnings.warn(
+                    f"--quant {cfg.quant} requested but FDT_QUANT=0 is "
+                    f"set: every quantized site computes the plain "
+                    f"full-precision matmul this run (scale state is "
+                    f"still allocated, so checkpoints interchange)",
+                    stacklevel=2)
+            use_pallas = None
+            if tp > 1:
+                # capability fallback, not a routing decision — the
+                # same reason flash reroutes on tp meshes above: the
+                # quant Pallas kernel is a custom call XLA's
+                # partitioner cannot split over the model axis.  The
+                # XLA reference path is a plain dot_general on int8/
+                # fp8 operands, which partitions like any other dot,
+                # so quantization itself stays on.
+                warnings.warn(
+                    f"--quant {cfg.quant}: the Pallas quant matmul "
+                    f"kernel cannot partition over the tp axis; using "
+                    f"the XLA reference quantized GEMMs on this "
+                    f"{dict(mesh.shape)} mesh (quantization stays on)",
+                    stacklevel=2)
+                use_pallas = False
+            elif jax.default_backend() != "tpu":
+                # the designed off-TPU path (tests/CPU convergence
+                # harness): reference GEMMs, same math, no interpret-
+                # mode Pallas on the hot path
+                use_pallas = False
+            if ffn_impl == "pallas":
+                warnings.warn(
+                    "--ffn_impl pallas does not compose with --quant "
+                    "(the monolithic fused-FFN kernel's GEMMs are "
+                    "bf16-only); using the flax FFN composition with "
+                    "quantized Dense GEMMs instead", stacklevel=2)
+                ffn_impl = "flax"
+            quant = policy._replace(use_pallas=use_pallas)
         # the model sees the mesh whenever it has work to do with it:
         # sequence-parallel attention, the sharded fused-FFN kernel, or
         # a model axis to annotate activations over (tp/sp activation
@@ -531,7 +583,13 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                          dtype=dtype, remat=cfg.remat,
                          remat_policy=cfg.remat_policy,
                          dropout_impl=cfg.dropout_impl, ffn_impl=ffn_impl,
-                         fused_qkv=not tricks_off)
+                         fused_qkv=not tricks_off, quant=quant)
+    if (getattr(cfg, "quant", "none") or "none") != "none":
+        import warnings
+        warnings.warn(
+            f"--quant {cfg.quant} is only wired for the transformer's "
+            f"GEMMs (attention projections + FFN); {cfg.model} runs "
+            f"full-precision", stacklevel=2)
     return get_model(cfg.model, cfg.num_classes, dtype=dtype,
                      remat=cfg.remat, conv_remat=not tricks_off)
 

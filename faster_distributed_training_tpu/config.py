@@ -55,6 +55,25 @@ class TrainConfig:
 
     # -- precision --------------------------------------------------------
     precision: str = "bf16"           # bf16 | fp32 | fp16 (fp16 uses loss scaling)
+    quant: str = "none"               # none | int8 | fp8: quantized-training
+                                      # mode for the transformer's hot GEMMs
+                                      # (attention q/k/v/out projections +
+                                      # both FFN matmuls): forward GEMMs run
+                                      # at int8 (s32 accumulation) or fp8
+                                      # E4M3 (fp32 accumulation) with
+                                      # per-tensor DELAYED scaling — amax
+                                      # histories ride the batch_stats
+                                      # collection through the fused-
+                                      # dispatch carry/checkpoints, so K-
+                                      # dispatch and kill-at-N resume stay
+                                      # bitwise (ops/quant.py,
+                                      # train.amp.QuantPolicy).  Kill
+                                      # switch: FDT_QUANT=0 (plain matmuls,
+                                      # same state tree).  tp meshes and
+                                      # off-TPU backends route the GEMMs
+                                      # through the XLA reference path
+                                      # (Pallas custom calls don't
+                                      # partition over tp)
 
     # -- device / mesh ----------------------------------------------------
     device: str = "auto"              # tpu | cpu | auto
@@ -189,6 +208,15 @@ class TrainConfig:
                                       # exceeds this multiple of the pod
                                       # median host-p95 (the [telemetry]
                                       # straggler line)
+    telemetry_every: int = 1          # record every Nth dispatch (compile-
+                                      # marked firsts always recorded).  The
+                                      # r12 note flags per-dispatch
+                                      # time.monotonic pressure under async
+                                      # dispatch as the first suspect if
+                                      # telemetry_overhead_pct fails on live
+                                      # TPU — this knob is the landed
+                                      # mitigation (sampled records keep
+                                      # their true step numbers)
 
     # -- failure detection / debugging ------------------------------------
     # The reference has neither (SURVEY.md §5: recovery = manual re-launch
@@ -258,6 +286,7 @@ def resolve_tricks(cfg: "TrainConfig") -> "TrainConfig":
         return cfg
     return cfg.replace(
         precision="fp32",
+        quant="none",
         attention="dense",
         mlp_impl="naive",
         dropout_impl="xla",
@@ -308,6 +337,15 @@ def build_parser(prog: str = "fdt",
                         "violate the dense-gradient assumption)")
     p.add_argument("--device", default=d.device, choices=["auto", "tpu", "cpu"])
     p.add_argument("--precision", default=d.precision, choices=["bf16", "fp32", "fp16"])
+    p.add_argument("--quant", default=d.quant,
+                   choices=["none", "int8", "fp8"],
+                   help="quantized-training mode (transformer): forward "
+                        "GEMMs of the attention projections + FFN at int8 "
+                        "(s32 accumulation) or fp8 E4M3 (fp32 accumulation) "
+                        "with per-tensor delayed scaling; scale state rides "
+                        "the train-state carry so K-dispatch/resume stay "
+                        "bitwise.  FDT_QUANT=0 kills it; tp meshes/off-TPU "
+                        "fall back to the XLA reference GEMMs (warned)")
     p.add_argument("--mesh", default="", type=str,
                    help="mesh as axis=size pairs, e.g. 'dp=4,tp=2' (a 2D "
                         "(data, model) mesh) or 'dp=4,fsdp=2'; axis "
@@ -352,6 +390,13 @@ def build_parser(prog: str = "fdt",
                    help="flag a host whose per-step p95 exceeds this "
                         "multiple of the pod median host-p95 in the "
                         "epoch [telemetry] line")
+    p.add_argument("--telemetry_every", default=d.telemetry_every,
+                   type=int,
+                   help="record every Nth dispatch in the telemetry "
+                        "stream (default 1 = all; compile-marked first "
+                        "dispatches are always recorded) — the mitigation "
+                        "for per-dispatch clock pressure under async "
+                        "dispatch")
     p.add_argument("--log_every", default=d.log_every, type=int,
                    help="live loss/acc/throughput line every N train steps "
                         "(0 disables; the reference's tqdm descriptors, "
@@ -501,7 +546,7 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         weight_decay=args.weight_decay, gamma=args.gamma,
         optimizer=args.optimizer, schedule=args.schedule,
         ngd_max_dim=args.ngd_max_dim,
-        device=args.device, precision=args.precision,
+        device=args.device, precision=args.precision, quant=args.quant,
         fsdp=args.fsdp, zero1=args.zero1, host_offload=args.host_offload,
         remat=args.remat, remat_policy=args.remat_policy,
         data_dir=args.data_dir, subset_stride=args.subset_stride, seed=args.seed,
@@ -510,6 +555,7 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         telemetry=not args.no_telemetry,
         telemetry_dir=args.telemetry_dir,
         straggler_ratio=args.straggler_ratio,
+        telemetry_every=args.telemetry_every,
         log_every=args.log_every,
         plot=not args.no_plot,
         auto_recover=args.auto_recover, debug=args.debug,

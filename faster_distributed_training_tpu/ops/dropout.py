@@ -56,6 +56,33 @@ from faster_distributed_training_tpu.ops.attention import _fmix32
 
 _GRID = 1 << 16  # keep-prob quantization grid (per-element u16 compare)
 
+# The documented uint32 global-index ceiling (keep_factor_rows
+# docstring), now a LOUD runtime guard instead of a silent wrap: the
+# element index global_row*cols + c mixes in uint32, so past 2^32
+# global elements distant positions silently share mask bits and the
+# per-element-draw contract is gone.  Shapes are static under jit, so
+# the check costs nothing at run time — it fires at trace time.
+_INDEX_CEILING = 1 << 32
+
+
+def guard_index_ceiling(n_elements: int, site: str = "hash dropout"
+                        ) -> None:
+    """Raise when a mask stream would address more than 2^32 global
+    elements.  Callers with a global-shape view (hash_dropout's full
+    tensor, the fused-FFN wrappers' rows x cols index space) invoke
+    this before building the stream; the fix when it fires is to widen
+    the mixing to 64 bits (two fmix rounds over row and column), not to
+    rely on the wrap."""
+    if int(n_elements) > _INDEX_CEILING:
+        raise ValueError(
+            f"{site}: {int(n_elements)} global elements exceed the "
+            f"uint32 index ceiling (2^32) of the stateless hash-dropout "
+            f"stream — positions past it would silently share mask "
+            f"bits.  Reduce the global activation size, set the site's "
+            f"dropout rate to 0, or use --dropout_impl xla for this "
+            f"run; the durable fix is widening ops/dropout.py's index "
+            f"mixing to 64 bits.")
+
 
 def _thresh_u16(rate: float) -> int:
     """Threshold on the u16 grid: keep iff (hash >> 16) < t; realized
@@ -123,6 +150,7 @@ def _keep_factor(seed: jax.Array, shape, rate: float) -> jax.Array:
     itself to bf16 first would bias the scale by up to ~0.4%).  Built on
     keep_factor_tile so every consumer shares one stream definition."""
     n = int(np.prod(shape)) if shape else 1
+    guard_index_ceiling(n)
     return keep_factor_tile(seed, jnp.uint32(0), 1, n, rate).reshape(shape)
 
 

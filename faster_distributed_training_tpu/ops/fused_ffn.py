@@ -51,7 +51,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from faster_distributed_training_tpu.ops.dropout import keep_factor_rows
+from faster_distributed_training_tpu.ops.dropout import (guard_index_ceiling,
+                                                         keep_factor_rows)
 from faster_distributed_training_tpu.ops.layernorm import (torch_layernorm,
                                                            torch_layernorm_f32)
 
@@ -312,6 +313,15 @@ def fused_ffn_sublayer(h, ln_scale, ln_bias, w1, b1, w2, b2,
     are 0 — pass anything).  Gradients flow to h, LN params, weights and
     biases; seeds are non-differentiable.  Dropout indices are the plain
     contiguous stream (global offsets are the sharded wrapper's job)."""
+    if rate_hidden > 0.0 or rate_conn > 0.0:
+        # loud guard on the documented 2^32 index ceiling (was a
+        # docstring-only caveat): rows x the widest ACTIVE mask must
+        # fit the uint32 stream — a rate-0 site draws no mask, so its
+        # width must not be able to reject a legal config
+        rows = int(np.prod(h.shape[:-1]))
+        width = max(int(w1.shape[1]) if rate_hidden > 0.0 else 0,
+                    int(h.shape[-1]) if rate_conn > 0.0 else 0)
+        guard_index_ceiling(rows * width, site="fused FFN dropout")
     return _ffn_core(h, ln_scale, ln_bias, w1, b1, w2, b2,
                      hid_seed, out_seed, jnp.uint32(0), jnp.uint32(0),
                      rate_hidden, rate_conn, eps, 1, 1)
@@ -350,6 +360,15 @@ def fused_ffn_sublayer_sharded(h, ln_scale, ln_bias, w1, b1, w2, b2,
     if h.ndim != 3:
         raise ValueError("fused_ffn_sublayer_sharded expects (B, L, d) "
                          f"activations, got shape {h.shape}")
+    if rate_hidden > 0.0 or rate_conn > 0.0:
+        # the wrap behavior this guard replaces was only documented:
+        # global rows (B*L) x the widest ACTIVE mask must fit uint32
+        # or distant shards would silently share mask bits (rate-0
+        # sites draw no mask and must not reject a legal config)
+        width = max(int(w1.shape[1]) if rate_hidden > 0.0 else 0,
+                    int(h.shape[-1]) if rate_conn > 0.0 else 0)
+        guard_index_ceiling(int(h.shape[0]) * int(h.shape[1]) * width,
+                            site="fused FFN dropout (sharded)")
     data_spec = P(batch_axes if len(batch_axes) != 1 else batch_axes[0],
                   seq_axis, None)
     rep = P(None)

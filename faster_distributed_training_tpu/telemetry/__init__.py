@@ -123,7 +123,9 @@ def build_telemetry(cfg, log: Callable[[str], None] = print
         return None
     if not getattr(cfg, "telemetry", True):
         return None
-    recorder = TelemetryRecorder(resolve_telemetry_dir(cfg), log=log)
+    recorder = TelemetryRecorder(
+        resolve_telemetry_dir(cfg),
+        step_every=int(getattr(cfg, "telemetry_every", 1) or 1), log=log)
     return RunTelemetry(
         recorder,
         straggler_ratio=float(getattr(cfg, "straggler_ratio", 2.0) or 2.0),

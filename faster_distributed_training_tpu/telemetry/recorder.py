@@ -148,6 +148,7 @@ class TelemetryRecorder:
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
                  capacity: int = 256,
+                 step_every: int = 1,
                  log: Callable[[str], None] = print):
         if process_index is None or process_count is None:
             # lazy import: resilience.coordinator imports telemetry.spans
@@ -165,6 +166,15 @@ class TelemetryRecorder:
         self.path = os.path.join(self.directory,
                                  f"host_{self.pi:05d}.jsonl")
         self.capacity = max(int(capacity), 1)
+        # --telemetry_every N: keep every Nth step record (the r12 note's
+        # mitigation for per-dispatch clock pressure under async
+        # dispatch).  Sampling drops whole records, never rewrites them,
+        # so surviving records carry their true step numbers; compile-
+        # marked first dispatches are always kept (there is exactly one
+        # per program and aggregation keys on them), and span/epoch/
+        # goodput events are never sampled.
+        self.step_every = max(int(step_every or 1), 1)
+        self._steps_seen = 0
         self._log = log
         self._lock = threading.Lock()
         self._buf: list = []
@@ -182,6 +192,10 @@ class TelemetryRecorder:
                     wall_ms: float, dispatch_ms: float, examples: int,
                     data_ms: float = 0.0, block_ms: float = 0.0,
                     compile_: bool = False) -> None:
+        self._steps_seen += 1
+        if (self.step_every > 1 and not compile_
+                and self._steps_seen % self.step_every):
+            return
         rec = {"kind": "step", "step": int(step), "epoch": int(epoch),
                "n": int(n), "k": int(k), "wall_ms": round(wall_ms, 3),
                "dispatch_ms": round(dispatch_ms, 3),
@@ -191,6 +205,17 @@ class TelemetryRecorder:
         if compile_:
             rec["compile"] = True
         self._append(rec)
+
+    def next_step_kept(self) -> bool:
+        """Whether the NEXT record_step call will be kept by the
+        --telemetry_every cadence (compile-marked records are kept
+        regardless).  The Trainer reads this BEFORE a dispatch so
+        sampled-out dispatches skip their telemetry-only clock reads
+        entirely — the actual point of the mitigation (dropping an
+        already-timed record would keep 100% of the monotonic
+        pressure); record_step remains the single counter owner."""
+        return (self.step_every <= 1
+                or (self._steps_seen + 1) % self.step_every == 0)
 
     def record_span(self, name: str, dur_ms: float,
                     step: Optional[int] = None) -> None:

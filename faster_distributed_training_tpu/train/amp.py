@@ -14,13 +14,56 @@ policy, but as a pure pytree inside the jitted step (no host sync)."""
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 COMPUTE_DTYPES = {"bf16": jnp.bfloat16, "fp16": jnp.float16,
                   "fp32": jnp.float32}
+
+
+class QuantPolicy(NamedTuple):
+    """Static description of the quantized-training mode (r13) — the
+    low-precision sibling of the loss-scale machinery below.  Where the
+    fp16 mode scales the LOSS so small gradients survive the format,
+    the quantized mode scales each GEMM OPERAND so its values fill the
+    int8/fp8 grid: per-tensor delayed scaling with a tracked amax
+    history (ops/quant.py owns the math and the kernels).
+
+    The policy itself is static (hashable — it rides flax module
+    fields); the per-tensor STATE (amax histories) lives in the model's
+    ``batch_stats`` collection, which the train step already threads
+    through the r8 fused-dispatch carry, checkpoints and the kill-at-N
+    bitwise resume — the same carry contract LossScaleState has.
+
+    fmt: "int8" (127-grid symmetric, s8xs8->s32 GEMMs) or "fp8"
+      (E4M3 forward operands, fp32 accumulation; E5M2 gradient
+      quantization is a documented future step).
+    amax_history_len: delayed-scaling window (Transformer Engine's
+      default neighborhood; the scale is qmax / max(history)).
+    margin: extra headroom multiplier on the running amax.
+    use_pallas: None = auto (Pallas kernel on TPU when the shape fits
+      the VMEM budget); False = force the XLA reference path — the
+      tp-mesh capability fallback sets this (Pallas custom calls don't
+      partition over tp, the r11 flash precedent)."""
+    fmt: str
+    amax_history_len: int = 16
+    margin: float = 1.0
+    use_pallas: Optional[bool] = None
+
+
+def resolve_quant_policy(cfg) -> Optional["QuantPolicy"]:
+    """cfg.quant -> QuantPolicy or None ("" / "none").  Mesh/backend
+    routing (use_pallas) is layered on by cli.build_model, which knows
+    the mesh."""
+    mode = (getattr(cfg, "quant", "none") or "none").lower()
+    if mode in ("", "none"):
+        return None
+    if mode not in ("int8", "fp8"):
+        raise ValueError(f"--quant must be none/int8/fp8, got {mode!r}")
+    return QuantPolicy(fmt=mode)
 
 
 class LossScaleState(NamedTuple):
@@ -57,10 +100,17 @@ def update_loss_scale(state: LossScaleState, grads_finite: jax.Array,
     if not enabled:
         return state
     grew = state.growth_count + 1 >= growth_interval
+    # backoff floors at fp32's smallest NORMAL: XLA:CPU flushes f32
+    # denormals to zero, and a zero scale is terminal (1/scale = inf
+    # poisons every later unscale, so the run could never recover even
+    # if the divergence was transient).  torch's GradScaler never
+    # reaches this range in practice; the floor only changes the
+    # already-doomed tail (pinned by tests/test_amp.py).
+    floor = float(np.finfo(np.float32).tiny)
     new_scale = jnp.where(
         grads_finite,
         jnp.where(grew, state.scale * growth_factor, state.scale),
-        state.scale * backoff_factor)
+        jnp.maximum(state.scale * backoff_factor, floor))
     new_count = jnp.where(grads_finite,
                           jnp.where(grew, 0, state.growth_count + 1), 0)
     return LossScaleState(scale=new_scale,

@@ -174,6 +174,22 @@ class Trainer:
             rec.record_span("first_dispatch_compile", dispatch_s * 1e3,
                             step=self.global_step)
 
+    def _keep_dispatch_times(self, program_key: tuple) -> bool:
+        """Whether this dispatch's telemetry-ONLY clock reads (data
+        wait + wall/dispatch decomposition) should be taken at all:
+        True when the step record will actually be kept — always for a
+        program's first (compile-marked) dispatch, else per the
+        --telemetry_every cadence (recorder.next_step_kept).  Sampling
+        at this layer is what removes the per-dispatch time.monotonic
+        pressure the r12 note flagged; the t_done/t_end reads stay
+        unconditional (the live-line blocked accounting needs them
+        regardless of telemetry)."""
+        tel = self.telemetry
+        if tel is None:
+            return False
+        return (program_key not in self._dispatched
+                or tel.recorder.next_step_kept())
+
     def _prof_before(self, kk: int) -> None:
         prof = self.profiler
         if prof is not None and not prof.done:
@@ -238,12 +254,13 @@ class Trainer:
                                   depth=self.cfg.prefetch_depth))
         try:
             while True:
-                t_rec = time.monotonic()
+                want = self._keep_dispatch_times(("host", 1))
+                t_rec = time.monotonic() if want else 0.0
                 try:
                     batch = next(it)
                 except StopIteration:
                     break
-                t_disp = time.monotonic()
+                t_disp = time.monotonic() if want else 0.0
                 self._prof_before(1)
                 state, metrics = self.train_step(state, batch)
                 t_done = time.monotonic()
@@ -255,9 +272,11 @@ class Trainer:
                     state = self._resilience_hooks(state, epoch, n)
                 t_end = time.monotonic()
                 self._blocked_since_log += t_end - t_done
-                self._record_dispatch(epoch, n, 1, t_end - t_rec,
-                                      t_done - t_disp, t_disp - t_rec,
-                                      t_end - t_done, ("host", 1))
+                self._record_dispatch(
+                    epoch, n, 1, t_end - t_rec if want else 0.0,
+                    t_done - t_disp if want else 0.0,
+                    t_disp - t_rec if want else 0.0,
+                    t_end - t_done, ("host", 1))
                 last = self._log_dispatch(epoch, n, 1, metrics, last)
         except BaseException:
             # stranded prefetch worker cleanup (Preempted, injected
@@ -342,13 +361,18 @@ class Trainer:
         self._blocked_since_log = 0.0
         try:
             while True:
+                # t_rec unconditional here: the program key (and so the
+                # compile-marking decision) needs the group's length,
+                # which is only known after the islice this clock read
+                # brackets — one read per K steps is already amortized
                 t_rec = time.monotonic()
                 group = list(itertools.islice(it, self.k))
                 if not group:
                     break
                 kk = len(group)
+                want = self._keep_dispatch_times(("host", kk))
                 batch = self.put_stacked(_stack_host_batches(group))
-                t_disp = time.monotonic()
+                t_disp = time.monotonic() if want else 0.0
                 self._prof_before(kk)
                 state, metrics = self._fused_step(kk)(state, batch)
                 t_done = time.monotonic()
@@ -361,9 +385,11 @@ class Trainer:
                                                    n_steps=kk)
                 t_end = time.monotonic()
                 self._blocked_since_log += t_end - t_done
-                self._record_dispatch(epoch, n, kk, t_end - t_rec,
-                                      t_done - t_disp, t_disp - t_rec,
-                                      t_end - t_done, ("host", kk))
+                self._record_dispatch(
+                    epoch, n, kk, t_end - t_rec if want else 0.0,
+                    t_done - t_disp if want else 0.0,
+                    t_disp - t_rec if want else 0.0,
+                    t_end - t_done, ("host", kk))
                 last = self._log_dispatch(epoch, n, kk, metrics, last)
         except BaseException:
             if closer is not None:
@@ -408,8 +434,9 @@ class Trainer:
         last = (t0, start_step)
         self._blocked_since_log = 0.0
         while n < n_steps:
-            t_rec = time.monotonic()
             kk = min(self.k, n_steps - n)
+            want = self._keep_dispatch_times(("resident", kk))
+            t_rec = time.monotonic() if want else 0.0
             self._prof_before(kk)
             state, metrics = self._fused_step(kk, resident)(
                 state, data, order,
@@ -424,9 +451,10 @@ class Trainer:
                                                n_steps=kk)
             t_end = time.monotonic()
             self._blocked_since_log += t_end - t_done
-            self._record_dispatch(epoch, n, kk, t_end - t_rec,
-                                  t_done - t_rec, 0.0, t_end - t_done,
-                                  ("resident", kk))
+            self._record_dispatch(
+                epoch, n, kk, t_end - t_rec if want else 0.0,
+                t_done - t_rec if want else 0.0, 0.0, t_end - t_done,
+                ("resident", kk))
             last = self._log_dispatch(epoch, n, kk, metrics, last)
         if metrics is not None:
             float(metrics["loss"])     # fence (see run_epoch)

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Guard-drift lint for bench.py's arm/guard registry (r13 satellite).
+
+The regression guard only protects metrics that bench arms actually
+emit; historically an arm could be added (or renamed) without anyone
+noticing it no longer matched the guard's pattern tables.  This lint
+makes that drift a tier-1 failure (tests/test_bench_arms.py):
+
+  1. every ``*_step_ms`` record-key string literal in bench.py's SOURCE
+     (AST scan, f-string placeholders normalized to ``*``) must match a
+     pattern in ``bench.PRODUCED_METRIC_PATTERNS`` — a new arm must be
+     registered before it can land;
+  2. every metric named in ``bench._EXPECTED_MOVES`` and
+     ``bench._ABS_PP_WORSE_IF_UP`` must match a produced pattern — the
+     guard must never reference a metric no arm can emit;
+  3. every produced ``*_step_ms`` pattern must either carry a noise
+     band (``bench.NOISE_BANDED_STEP_MS``, the r6 N-interleaved
+     protocol) or be consciously allowlisted in
+     ``bench.SINGLE_RUN_STEP_MS`` — new step-ms arms can't silently
+     skip the noise protocol;
+  4. the three registries must not name patterns nothing produces
+     (stale entries rot the lint itself).
+
+Run:  python scripts/check_bench_arms.py   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+BENCH_PATH = os.path.join(_REPO, "bench.py")
+
+# source-literal shapes that are NOT record keys: child-payload field
+# names read back from subprocess JSON, and the bare class-threshold
+# fragment the guard tables use for substring matching
+_IGNORED_LITERALS = {"median_step_ms", "mean_step_ms", "max_step_ms",
+                     "step_ms"}
+
+# a record-key-shaped name: lowercase/digits/underscore/wildcard only
+# (docstrings and log messages contain "step_ms" too, but with spaces)
+_KEYLIKE = re.compile(r"^[a-z0-9_*{}]+$")
+
+
+def _literal_of(node: ast.AST) -> str | None:
+    """String value of a Constant/JoinedStr node, FormattedValue
+    placeholders rendered as ``*`` (so f-string keys become fnmatch
+    patterns)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+_REGISTRY_NAMES = {"PRODUCED_METRIC_PATTERNS", "NOISE_BANDED_STEP_MS",
+                   "SINGLE_RUN_STEP_MS"}
+
+
+def source_step_ms_names(path: str | None = None) -> set:
+    """Every key-shaped ``*step_ms*`` string literal in the file —
+    excluding (a) Constant fragments that are parts of an f-string
+    (the JoinedStr they belong to is scanned whole) and (b) the
+    registry's own pattern tables (the lint must scan the ARMS, not
+    itself)."""
+    if path is None:
+        path = BENCH_PATH   # read at call time (test monkeypatch seam)
+    with open(path) as fh:
+        tree = ast.parse(fh.read())
+    skip = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.JoinedStr):
+            for child in ast.walk(node):
+                if child is not node:
+                    skip.add(id(child))
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id in _REGISTRY_NAMES
+                for t in node.targets):
+            for child in ast.walk(node):
+                skip.add(id(child))
+    names = set()
+    for node in ast.walk(tree):
+        if id(node) in skip:
+            continue
+        s = _literal_of(node)
+        if not s or "step_ms" not in s:
+            continue
+        if not _KEYLIKE.match(s):
+            continue          # prose (docstrings, warnings) has spaces
+        if s in _IGNORED_LITERALS:
+            continue
+        if s.endswith("_noise_band_pct"):
+            s = s[: -len("_noise_band_pct")]
+        names.add(s)
+    return names
+
+
+def _matches(name: str, patterns) -> bool:
+    """Two-sided fnmatch: the scanned name may itself contain ``*``
+    (f-string placeholders), so compare both directions."""
+    return any(fnmatch.fnmatch(name, p) or fnmatch.fnmatch(p, name)
+               for p in patterns)
+
+
+def check() -> list:
+    """All registry-drift problems found, [] when clean."""
+    import bench
+
+    produced = tuple(bench.PRODUCED_METRIC_PATTERNS)
+    banded = tuple(bench.NOISE_BANDED_STEP_MS)
+    single = tuple(bench.SINGLE_RUN_STEP_MS)
+    problems = []
+
+    # 1. every step_ms literal in source is a registered produced metric
+    scanned = source_step_ms_names()
+    for name in sorted(scanned):
+        if not _matches(name, produced):
+            problems.append(
+                f"source emits step-ms key {name!r} that matches no "
+                f"bench.PRODUCED_METRIC_PATTERNS entry — register the "
+                f"new arm so the guard sees it")
+
+    # 2. every guard-table metric is producible
+    for key in sorted(set(bench._EXPECTED_MOVES)
+                      | set(bench._ABS_PP_WORSE_IF_UP)):
+        if not _matches(key, produced):
+            problems.append(
+                f"guard table names {key!r} but no produced-metric "
+                f"pattern covers it — the guard references a metric no "
+                f"arm emits")
+
+    # 3. every produced step_ms pattern is banded or consciously single-run
+    for pat in produced:
+        if "step_ms" not in pat:
+            continue
+        if not (_matches(pat, banded) or _matches(pat, single)):
+            problems.append(
+                f"produced step-ms pattern {pat!r} is neither in "
+                f"NOISE_BANDED_STEP_MS nor allowlisted in "
+                f"SINGLE_RUN_STEP_MS — new arms must join the r6 noise "
+                f"protocol or opt out explicitly")
+
+    # 4. no stale registry entries (patterns nothing in source produces)
+    for pat in banded + single:
+        if not _matches(pat, produced):
+            problems.append(
+                f"registry entry {pat!r} matches no produced pattern — "
+                f"stale after an arm rename/removal?")
+    for pat in produced:
+        if "step_ms" in pat and not _matches(pat, scanned):
+            problems.append(
+                f"PRODUCED_METRIC_PATTERNS entry {pat!r} matches no "
+                f"step-ms literal in bench.py source — stale after an "
+                f"arm rename/removal?")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        for p in problems:
+            print(f"[check_bench_arms] {p}")
+        print(f"[check_bench_arms] {len(problems)} problem(s)")
+        return 1
+    print("[check_bench_arms] OK: produced metrics, guard tables and "
+          "noise-band registry agree")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

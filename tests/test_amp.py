@@ -1,0 +1,170 @@
+"""Direct train/amp.py loss-scale coverage (r13 satellite — previously
+these behaviors were only exercised through fp16 e2e runs): non-finite
+grads at the bottom of the scale range, growth-interval crossing inside
+a K-fused dispatch (lax.scan carry), and scale-state bitwise equality
+across a kill-at-N resume."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from faster_distributed_training_tpu.config import TrainConfig
+from faster_distributed_training_tpu.train.amp import (LossScaleState,
+                                                       fresh_loss_scale,
+                                                       scale_loss,
+                                                       unscale_and_check,
+                                                       update_loss_scale)
+
+
+class TestLossScaleUnit:
+    def test_nonfinite_at_minimum_scale_floors_positive(self):
+        """torch's GradScaler has no floor, but XLA:CPU flushes f32
+        denormals to zero and a zero scale is TERMINAL (1/scale = inf
+        poisons every later unscale) — so the backoff floors at fp32's
+        smallest normal: repeated non-finite steps at the bottom of the
+        range keep the scale positive and finite, the growth counter
+        resets, and a later finite phase can still recover."""
+        tiny = float(np.finfo(np.float32).tiny)
+        st = LossScaleState(
+            scale=jnp.asarray(tiny * 4, jnp.float32),
+            growth_count=jnp.asarray(7, jnp.int32))
+        for want in (tiny * 2, tiny, tiny, tiny):
+            st = update_loss_scale(st, jnp.asarray(False), enabled=True)
+            s = float(st.scale)
+            assert s == pytest.approx(want) and s > 0.0
+            assert np.isfinite(s)
+            assert int(st.growth_count) == 0
+        # recovery is still possible from the floor
+        st = update_loss_scale(st, jnp.asarray(True), enabled=True,
+                               growth_interval=1)
+        assert float(st.scale) == pytest.approx(tiny * 2)
+
+    def test_unscale_detects_nonfinite_and_divides_exactly(self):
+        st = fresh_loss_scale(16.0)
+        grads = {"a": jnp.asarray([32.0, 8.0]), "b": jnp.asarray([4.0])}
+        out, finite = unscale_and_check(grads, st, enabled=True)
+        assert bool(finite)
+        np.testing.assert_array_equal(np.asarray(out["a"]), [2.0, 0.5])
+        bad = {"a": jnp.asarray([jnp.inf]), "b": jnp.asarray([1.0])}
+        _, finite = unscale_and_check(bad, st, enabled=True)
+        assert not bool(finite)
+        nan = {"a": jnp.asarray([jnp.nan])}
+        _, finite = unscale_and_check(nan, st, enabled=True)
+        assert not bool(finite)
+
+    def test_disabled_policy_is_identity(self):
+        st = fresh_loss_scale()
+        assert float(scale_loss(jnp.asarray(3.0), st, enabled=False)) == 3.0
+        g = {"a": jnp.asarray([2.0])}
+        out, finite = unscale_and_check(g, st, enabled=False)
+        assert out is g and bool(finite)
+        assert update_loss_scale(st, jnp.asarray(False),
+                                 enabled=False) is st
+
+    def test_backoff_resets_growth_count_mid_interval(self):
+        st = LossScaleState(scale=jnp.asarray(1024.0, jnp.float32),
+                            growth_count=jnp.asarray(3, jnp.int32))
+        st = update_loss_scale(st, jnp.asarray(False), enabled=True,
+                               growth_interval=4)
+        assert float(st.scale) == 512.0 and int(st.growth_count) == 0
+        # the interval restarts from scratch: 3 finite steps don't grow
+        for _ in range(3):
+            st = update_loss_scale(st, jnp.asarray(True), enabled=True,
+                                   growth_interval=4)
+        assert float(st.scale) == 512.0 and int(st.growth_count) == 3
+        st = update_loss_scale(st, jnp.asarray(True), enabled=True,
+                               growth_interval=4)
+        assert float(st.scale) == 1024.0 and int(st.growth_count) == 0
+
+    def test_growth_interval_crossing_inside_scan_matches_sequential(self):
+        """The r8 fused-dispatch contract at the amp layer: threading
+        the loss-scale state through a lax.scan carry (K steps in one
+        dispatch) crosses the growth interval at exactly the same step,
+        bitwise, as the K=1 sequential updates — including a dispatch
+        whose K steps straddle the crossing."""
+        interval = 4
+
+        def upd(st, finite):
+            return update_loss_scale(st, finite, enabled=True,
+                                     growth_interval=interval)
+
+        finites = jnp.asarray([True, True, True, True, True, True,
+                               False, True, True, True])
+        # sequential reference
+        seq = LossScaleState(scale=jnp.asarray(256.0, jnp.float32),
+                             growth_count=jnp.asarray(2, jnp.int32))
+        states = []
+        for i in range(10):
+            seq = upd(seq, finites[i])
+            states.append(seq)
+        # growth fires at step 2 (count 2 + 2 more = interval 4), again
+        # at step 6, and the injected non-finite step 7 backs off
+        assert float(states[1].scale) == 512.0
+        assert float(states[5].scale) == 1024.0
+        assert float(states[6].scale) == 512.0
+
+        # scanned K=5 dispatches (the second dispatch straddles the
+        # non-finite step AND a fresh interval build-up)
+        def body(st, f):
+            st = upd(st, f)
+            return st, ()
+
+        sc = LossScaleState(scale=jnp.asarray(256.0, jnp.float32),
+                            growth_count=jnp.asarray(2, jnp.int32))
+        sc, _ = lax.scan(body, sc, finites[:5])
+        np.testing.assert_array_equal(np.asarray(sc.scale),
+                                      np.asarray(states[4].scale))
+        sc, _ = lax.scan(body, sc, finites[5:])
+        np.testing.assert_array_equal(np.asarray(sc.scale),
+                                      np.asarray(states[-1].scale))
+        np.testing.assert_array_equal(np.asarray(sc.growth_count),
+                                      np.asarray(states[-1].growth_count))
+
+
+def _fp16_cfg(tmp, **kw):
+    """Tiny fp16 transformer run (the test_fused_dispatch twin shape):
+    8 steps/epoch x 2 epochs, dynamic loss scaling active."""
+    base = dict(model="transformer", dataset="synthetic",
+                num_classes=4, batch_size=8, seq_len=16, n_layers=1,
+                d_model=16, d_ff=32, n_heads=2, epochs=2,
+                subset_stride=64, optimizer="sgd", precision="fp16",
+                plot=False, workers=2, log_every=0, donate=False,
+                checkpoint_dir=str(tmp))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+class TestLossScaleResumeE2E:
+    """ISSUE satellite: scale-state bitwise equality across a
+    kill-at-N resume — the LossScaleState rides the checkpointed carry
+    exactly like params/opt state, so a resumed fp16 run must carry the
+    identical (scale, growth_count) pair forward."""
+
+    @pytest.fixture(scope="class")
+    def fp16_reference(self, tmp_path_factory):
+        from faster_distributed_training_tpu.cli import run_training
+        tmp = tmp_path_factory.mktemp("fp16ref")
+        return run_training(_fp16_cfg(tmp), log=lambda *_: None)["state"]
+
+    def test_killed_fp16_run_resumes_scale_state_bitwise(
+            self, fp16_reference, tmp_path, monkeypatch):
+        from faster_distributed_training_tpu.cli import run_training
+        from faster_distributed_training_tpu.resilience import faults
+        monkeypatch.setenv(faults.ENV_DIE, "6")
+        got = run_training(
+            _fp16_cfg(tmp_path, steps_per_dispatch=4,
+                      data_path="resident", checkpoint_every=4,
+                      supervise=True),
+            log=lambda *_: None)["state"]
+        ref = fp16_reference
+        assert int(got.step) == int(ref.step) == 16
+        np.testing.assert_array_equal(np.asarray(got.loss_scale.scale),
+                                      np.asarray(ref.loss_scale.scale))
+        np.testing.assert_array_equal(
+            np.asarray(got.loss_scale.growth_count),
+            np.asarray(ref.loss_scale.growth_count))
+        for a, b in zip(jax.tree.leaves(got.params),
+                        jax.tree.leaves(ref.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

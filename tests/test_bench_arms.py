@@ -1,0 +1,79 @@
+"""Guard-drift lint for bench.py's arm registry (r13 satellite):
+tier-1 wrapper around scripts/check_bench_arms.py, so a bench arm can
+never again be added/renamed without the regression gate seeing it.
+
+Fast by construction: pure AST scanning + fnmatch, no jax, no bench
+execution."""
+
+import os
+import sys
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+
+import check_bench_arms as lint  # noqa: E402
+
+
+class TestBenchArmRegistry:
+    def test_registry_and_source_agree(self):
+        """THE gate: every *_step_ms key bench.py can emit is
+        registered, every guard-table metric is producible, and every
+        step-ms pattern is either noise-banded or consciously
+        single-run."""
+        assert lint.check() == []
+
+    def test_quant_arms_are_registered_and_banded(self):
+        """The arms this PR adds must be covered by the registry the
+        way the ISSUE requires: present, banded, and step_ms-guarded
+        (the _LOWER_IS_BETTER 'step_ms' class plus _is_live_record
+        gating applies to every *_step_ms key uniformly)."""
+        import bench
+        for key in ("transformer_bs256_seq256_int8_step_ms",
+                    "transformer_bs256_seq256_fp8_step_ms",
+                    "transformer_bs256_seq256_quant_off_step_ms"):
+            assert lint._matches(key, bench.PRODUCED_METRIC_PATTERNS)
+            assert lint._matches(key, bench.NOISE_BANDED_STEP_MS)
+            assert any(p in key for p in bench._LOWER_IS_BETTER)
+
+    def test_scanner_extracts_fstring_keys(self, tmp_path):
+        src = tmp_path / "fake_bench.py"
+        src.write_text(
+            'record[f"foo_bs{bs}_step_ms"] = 1\n'
+            'record["bar_step_ms" + "_noise_band_pct"] = 2\n'
+            'x = r["median_step_ms"]\n'          # child field: ignored
+            '"""prose about *_step_ms arms"""\n'  # docstring: ignored
+        )
+        names = lint.source_step_ms_names(str(src))
+        assert names == {"foo_bs*_step_ms", "bar_step_ms"}
+
+    def test_lint_catches_unregistered_arm(self, tmp_path,
+                                           monkeypatch):
+        """A new record key that matches no registry pattern must be a
+        failure — the whole point of the lint."""
+        src = tmp_path / "fake_bench.py"
+        src.write_text('record["brand_new_arm_step_ms"] = 1\n')
+        monkeypatch.setattr(lint, "BENCH_PATH", str(src))
+        problems = lint.check()
+        assert any("brand_new_arm_step_ms" in p for p in problems)
+
+    def test_unbanding_a_banded_arm_fails(self, monkeypatch):
+        """Review-pass regression: a broad transformer_bs*_seq* entry
+        in SINGLE_RUN_STEP_MS once swallowed every transformer step-ms
+        arm, so un-banding the quant arms kept the lint green.  Now the
+        single-run allowlist is exact keys — dropping the quant arms
+        from NOISE_BANDED_STEP_MS must produce problems."""
+        import bench
+        stripped = tuple(p for p in bench.NOISE_BANDED_STEP_MS
+                         if "int8" not in p and "fp8" not in p
+                         and "quant" not in p)
+        monkeypatch.setattr(bench, "NOISE_BANDED_STEP_MS", stripped)
+        probs = lint.check()
+        assert any("int8" in p for p in probs)
+
+    def test_guard_tables_reference_producible_metrics_only(self):
+        import bench
+        for key in list(bench._EXPECTED_MOVES) \
+                + list(bench._ABS_PP_WORSE_IF_UP):
+            assert lint._matches(key, bench.PRODUCED_METRIC_PATTERNS), key

@@ -313,3 +313,84 @@ class TestTransformerHashDropout:
                 model.apply({"params": variables["params"]}, x, train=False)))
         np.testing.assert_allclose(outs[0], outs[1], atol=1e-6)
         np.testing.assert_allclose(outs[0], outs[2], atol=1e-6)
+
+
+class TestIndexCeilingGuard:
+    """r13 satellite: the documented 2^32 global-index ceiling is now a
+    loud trace-time guard (ops.dropout.guard_index_ceiling) instead of
+    a silent uint32 wrap.  jax.eval_shape exercises the guard without
+    materializing the (deliberately enormous) operands."""
+
+    def test_guard_function_boundary(self):
+        from faster_distributed_training_tpu.ops.dropout import (
+            guard_index_ceiling)
+        guard_index_ceiling(1 << 32)          # at the ceiling: fine
+        with pytest.raises(ValueError, match="uint32 index ceiling"):
+            guard_index_ceiling((1 << 32) + 1)
+
+    def test_hash_dropout_raises_at_trace_time_past_ceiling(self):
+        from faster_distributed_training_tpu.ops.dropout import (
+            hash_dropout)
+        big = jax.ShapeDtypeStruct((1 << 17, 1 << 16), jnp.float32)
+
+        def f(x):
+            return hash_dropout(x, jnp.uint32(1), 0.1)
+
+        with pytest.raises(ValueError, match="uint32 index ceiling"):
+            jax.eval_shape(f, big)
+        # a large-but-legal tensor still traces
+        ok = jax.ShapeDtypeStruct((1 << 10, 1 << 10), jnp.float32)
+        assert jax.eval_shape(f, ok).shape == (1 << 10, 1 << 10)
+
+    def test_fused_ffn_guards_global_rows_times_cols(self):
+        from faster_distributed_training_tpu.ops.fused_ffn import (
+            fused_ffn_sublayer)
+        d, dff = 64, 128
+        rows = (1 << 32) // dff + 1           # rows * d_ff > 2^32
+
+        def f(h, lns, lnb, w1, b1, w2, b2):
+            return fused_ffn_sublayer(h, lns, lnb, w1, b1, w2, b2,
+                                      jnp.uint32(1), jnp.uint32(2),
+                                      rate_hidden=0.1, rate_conn=0.1)
+
+        args = (jax.ShapeDtypeStruct((rows, d), jnp.float32),
+                jax.ShapeDtypeStruct((d,), jnp.float32),
+                jax.ShapeDtypeStruct((d,), jnp.float32),
+                jax.ShapeDtypeStruct((d, dff), jnp.float32),
+                jax.ShapeDtypeStruct((dff,), jnp.float32),
+                jax.ShapeDtypeStruct((dff, d), jnp.float32),
+                jax.ShapeDtypeStruct((d,), jnp.float32))
+        with pytest.raises(ValueError, match="uint32 index ceiling"):
+            jax.eval_shape(f, *args)
+
+    def test_fused_ffn_guard_counts_only_active_mask_widths(self):
+        """Review-pass regression: with only the CONNECTION dropout
+        active the index space is rows x d (not rows x d_ff) — a
+        config whose narrow stream fits must not be rejected by the
+        inactive wide one."""
+        from faster_distributed_training_tpu.ops.fused_ffn import (
+            fused_ffn_sublayer)
+        d, dff, rows = 32, 128, 1 << 26     # rows*d = 2^31, rows*dff = 2^33
+
+        def f(h, lns, lnb, w1, b1, w2, b2):
+            return fused_ffn_sublayer(h, lns, lnb, w1, b1, w2, b2,
+                                      jnp.uint32(1), jnp.uint32(2),
+                                      rate_hidden=0.0, rate_conn=0.1)
+
+        args = (jax.ShapeDtypeStruct((rows, d), jnp.float32),
+                jax.ShapeDtypeStruct((d,), jnp.float32),
+                jax.ShapeDtypeStruct((d,), jnp.float32),
+                jax.ShapeDtypeStruct((d, dff), jnp.float32),
+                jax.ShapeDtypeStruct((dff,), jnp.float32),
+                jax.ShapeDtypeStruct((dff, d), jnp.float32),
+                jax.ShapeDtypeStruct((d,), jnp.float32))
+        assert jax.eval_shape(f, *args).shape == (rows, d)
+
+    def test_rate_zero_skips_the_guard(self):
+        # dropout-free giant tensors draw no masks, so no ceiling
+        from faster_distributed_training_tpu.ops.dropout import (
+            hash_dropout)
+        big = jax.ShapeDtypeStruct((1 << 17, 1 << 16), jnp.float32)
+        out = jax.eval_shape(lambda x: hash_dropout(x, jnp.uint32(1),
+                                                    0.0), big)
+        assert out.shape == big.shape

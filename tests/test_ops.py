@@ -517,3 +517,40 @@ class TestSavedStatsLayerNorm:
         gx_ref = jax.grad(loss_ref)(x)
         np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
                                    rtol=2e-5, atol=2e-6)
+
+
+class TestFfnVmemDtypeBytes:
+    """r13 satellite: ffn_kernel_fits_vmem's weight-byte parameter must
+    follow the ACTUAL compute dtype at the build_model call site — an
+    fp32 run must not falsely pass the budget sized for bf16, and
+    1-byte (quantized) weights must not be falsely rejected.  The
+    (1280, 1280) cell is chosen to straddle the 12 MiB budget: weights
+    alone are 6.25 MiB at bf16, 12.5 MiB at fp32, 3.13 MiB at int8."""
+
+    def test_w_bytes_drive_the_verdict(self):
+        from faster_distributed_training_tpu.ops.fused_ffn import (
+            ffn_kernel_fits_vmem)
+        assert ffn_kernel_fits_vmem(1280, 1280, w_bytes=2)       # bf16
+        assert not ffn_kernel_fits_vmem(1280, 1280, w_bytes=4)   # fp32
+        assert ffn_kernel_fits_vmem(1280, 1280, w_bytes=1)       # int8
+
+    def test_build_model_passes_compute_dtype_itemsize(self):
+        import warnings as _w
+        from faster_distributed_training_tpu.cli import build_model
+        from faster_distributed_training_tpu.config import TrainConfig
+
+        def mk(precision):
+            return TrainConfig(model="transformer", dataset="synthetic",
+                               num_classes=4, batch_size=4, seq_len=16,
+                               n_layers=1, d_model=1280, d_ff=1280,
+                               n_heads=4, precision=precision,
+                               attention="dense", ffn_impl="pallas")
+
+        with pytest.warns(UserWarning, match="VMEM budget"):
+            m32 = build_model(mk("fp32"), vocab_size=100)
+        assert m32.ffn_impl == "flax"      # fp32 weights bust the budget
+        with _w.catch_warnings(record=True) as caught:
+            _w.simplefilter("always")
+            m16 = build_model(mk("bf16"), vocab_size=100)
+        assert m16.ffn_impl == "pallas"    # bf16 weights fit
+        assert not any("VMEM budget" in str(c.message) for c in caught)

@@ -1,0 +1,488 @@
+"""Quantized-training tests (r13 tentpole): ops/quant.py's pure
+helpers and kernels, the QuantDense flax site, cli/build_model routing,
+and the e2e contracts the ISSUE acceptance names — int8 and fp8 run the
+full transformer training path on CPU (XLA reference GEMMs), the
+quant-scale state is bitwise-reproducible across K in {1,4} fused
+dispatch and a kill-at-N resume, and final eval accuracy stays within
+±0.3 percentage points of the bf16-path run on the CPU-scale
+convergence harness (the ACCURACY.md pin protocol).
+
+All CPU tier-1; donate=False in e2e runs (the known multiple-donating-
+programs-per-process backend hazard, see test_resilience.py)."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from faster_distributed_training_tpu.config import TrainConfig
+from faster_distributed_training_tpu.ops import quant as Q
+
+
+def _assert_tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestScaleState:
+    def test_amax_history_rolls_newest_first(self):
+        h = Q.fresh_amax_history(4)
+        h = Q.update_amax_history(h, 2.0)
+        h = Q.update_amax_history(h, 3.0)
+        np.testing.assert_allclose(np.asarray(h), [3.0, 2.0, 0.0, 0.0])
+        # oldest falls off the window
+        for v in (4.0, 5.0, 6.0):
+            h = Q.update_amax_history(h, v)
+        np.testing.assert_allclose(np.asarray(h), [6.0, 5.0, 4.0, 3.0])
+
+    def test_scale_is_qmax_over_running_amax(self):
+        h = Q.update_amax_history(Q.fresh_amax_history(4), 2.0)
+        s = float(Q.scale_from_history(h, "int8"))
+        assert s == pytest.approx(127.0 / 2.0)
+        s8 = float(Q.scale_from_history(h, "fp8"))
+        assert s8 == pytest.approx(448.0 / 2.0)
+        # margin buys headroom (shrinks the scale)
+        sm = float(Q.scale_from_history(h, "int8", margin=2.0))
+        assert sm == pytest.approx(127.0 / 4.0)
+
+    def test_fresh_history_yields_identity_scale(self):
+        # all-zero history = "never observed": quantizing at scale 1.0
+        # is exact for the zeros it will meet, and the first real step
+        # seeds the history
+        s = float(Q.scale_from_history(Q.fresh_amax_history(4), "int8"))
+        assert s == 1.0
+
+    def test_history_max_not_newest_drives_scale(self):
+        h = Q.fresh_amax_history(4)
+        h = Q.update_amax_history(h, 8.0)
+        h = Q.update_amax_history(h, 1.0)   # transient dip
+        s = float(Q.scale_from_history(h, "int8"))
+        assert s == pytest.approx(127.0 / 8.0)   # window max rules
+
+
+class TestQuantDequant:
+    def test_int8_roundtrip_error_bound(self):
+        rr = np.random.default_rng(0)
+        x = jnp.asarray(rr.normal(size=(64, 32)) * 3.0, jnp.float32)
+        amax = float(jnp.max(jnp.abs(x)))
+        s = jnp.float32(127.0 / amax)
+        back = Q.dequantize(Q.quantize_int8(x, s), s)
+        # one-grid-step rounding: |err| <= 0.5/scale
+        assert float(jnp.max(jnp.abs(back - x))) <= 0.5 / float(s) + 1e-6
+
+    def test_int8_saturates_symmetric(self):
+        x = jnp.asarray([-1e9, 1e9], jnp.float32)
+        q = np.asarray(Q.quantize_int8(x, jnp.float32(1.0)))
+        np.testing.assert_array_equal(q, [-127, 127])
+
+    def test_fp8_e4m3_roundtrip_and_saturation(self):
+        rr = np.random.default_rng(1)
+        x = jnp.asarray(rr.normal(size=(64, 32)), jnp.float32)
+        amax = float(jnp.max(jnp.abs(x)))
+        s = jnp.float32(448.0 / amax)
+        q = Q.quantize_fp8(x, s, "e4m3")
+        assert q.dtype == jnp.float8_e4m3fn
+        back = Q.dequantize(q, s)
+        assert np.all(np.isfinite(np.asarray(back, np.float32)))
+        # e4m3: 3 mantissa bits -> relative error <= 2^-4 for normals
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                                   rtol=0.0, atol=amax * 2.0 ** -4)
+        # overflow clips to the finite max instead of landing on NaN
+        q_over = Q.quantize_fp8(jnp.asarray([1e9], jnp.float32),
+                                jnp.float32(1.0), "e4m3")
+        assert float(np.asarray(q_over, np.float32)[0]) == 448.0
+
+    def test_fp8_e5m2_is_the_wide_range_variant(self):
+        q = Q.quantize(jnp.asarray([4096.0], jnp.float32),
+                       jnp.float32(1.0), "fp8_e5m2")
+        assert q.dtype == jnp.float8_e5m2
+        assert float(np.asarray(q, np.float32)[0]) == 4096.0
+
+    def test_unknown_format_raises(self):
+        with pytest.raises(ValueError, match="unknown quant format"):
+            Q.quantize(jnp.zeros((2,)), jnp.float32(1.0), "int4")
+
+
+class TestQuantDot:
+    def _operands(self, m=16, k=32, n=8, seed=0):
+        rr = np.random.default_rng(seed)
+        x = jnp.asarray(rr.normal(size=(m, k)), jnp.float32)
+        w = jnp.asarray(rr.normal(size=(k, n)) * 0.1, jnp.float32)
+        sx = Q.scale_from_history(
+            Q.update_amax_history(Q.fresh_amax_history(4),
+                                  Q.tensor_amax(x)), "int8")
+        sw = Q.scale_from_history(
+            Q.update_amax_history(Q.fresh_amax_history(4),
+                                  Q.tensor_amax(w)), "int8")
+        return x, w, sx, sw
+
+    def test_int8_close_to_float_matmul(self):
+        x, w, sx, sw = self._operands()
+        out = Q.quant_dot(x, w, sx, sw, "int8", use_pallas=False)
+        ref = x @ w
+        # per-element quantization noise accumulates ~sqrt(K); bound it
+        # loosely but meaningfully vs the full-precision product
+        err = float(jnp.max(jnp.abs(out - ref)))
+        assert err < 0.05 * float(jnp.max(jnp.abs(ref)))
+
+    def test_int8_accumulation_is_exact_int32(self):
+        # the contraction itself is exact: quant_dot on pre-scaled
+        # integers reproduces the integer product exactly
+        xq = jnp.asarray([[127, -127], [1, 2]], jnp.float32)
+        wq = jnp.asarray([[1, 2], [3, -4]], jnp.float32)
+        out = Q.quant_dot(xq, wq, jnp.float32(1.0), jnp.float32(1.0),
+                          "int8", use_pallas=False)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(xq) @ np.asarray(wq))
+
+    def test_fp8_close_to_float_matmul(self):
+        x, w, _, _ = self._operands(seed=2)
+        hx = Q.update_amax_history(Q.fresh_amax_history(4),
+                                   Q.tensor_amax(x))
+        hw = Q.update_amax_history(Q.fresh_amax_history(4),
+                                   Q.tensor_amax(w))
+        out = Q.quant_dot(x, w, Q.scale_from_history(hx, "fp8"),
+                          Q.scale_from_history(hw, "fp8"), "fp8",
+                          use_pallas=False)
+        ref = x @ w
+        err = float(jnp.max(jnp.abs(out - ref)))
+        assert err < 0.1 * float(jnp.max(jnp.abs(ref)))
+
+    def test_pallas_interpret_matches_reference_bitwise(self):
+        # off-TPU the kernel runs in interpret mode: same quantize ->
+        # int32-accumulate -> fp32 descale op chain, so the outputs are
+        # bit-identical to the XLA reference path
+        x, w, sx, sw = self._operands(m=40, k=16, n=8, seed=3)
+        ref = Q.quant_dot(x, w, sx, sw, "int8", use_pallas=False)
+        ker = Q.quant_dot(x, w, sx, sw, "int8", use_pallas=True)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(ker))
+
+    def test_vmem_guard_degrades_to_reference_with_warning(self):
+        assert Q.quant_kernel_fits_vmem(512, 1024)
+        assert not Q.quant_kernel_fits_vmem(4096, 4096)
+        rr = np.random.default_rng(4)
+        x = jnp.asarray(rr.normal(size=(4, 4096)), jnp.float32)
+        w = jnp.asarray(rr.normal(size=(4096, 4096)) * 0.02, jnp.float32)
+        sx = sw = jnp.float32(1.0)
+        xq, wq = Q.quantize(x, sx, "int8"), Q.quantize(w, sw, "int8")
+        with pytest.warns(UserWarning, match="VMEM budget"):
+            out = Q.quant_dot_pallas(xq, wq, sx, sw, "int8", jnp.float32)
+        ref = Q.quant_dot_reference(xq, wq, sx, sw, "int8", jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_backward_is_ste_on_dequantized_operands(self):
+        x, w, sx, sw = self._operands(m=8, k=16, n=4, seed=5)
+
+        def loss(x_, w_):
+            return jnp.sum(Q.quant_dot(x_, w_, sx, sw, "int8",
+                                       use_pallas=False))
+
+        dx, dw = jax.grad(loss, argnums=(0, 1))(x, w)
+        x_deq = Q.dequantize(Q.quantize(x, sx, "int8"), sx)
+        w_deq = Q.dequantize(Q.quantize(w, sw, "int8"), sw)
+        g = jnp.ones((8, 4), jnp.float32)
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(g @ w_deq.T),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(dw), np.asarray(x_deq.T @ g),
+                                   rtol=1e-6)
+
+    def test_scales_get_zero_cotangents(self):
+        x, w, sx, sw = self._operands(m=4, k=8, n=2, seed=6)
+        ds = jax.grad(lambda s: jnp.sum(Q.quant_dot(x, w, s, sw, "int8",
+                                                    use_pallas=False)))(sx)
+        assert float(ds) == 0.0
+
+
+class TestQuantDense:
+    def _apply(self, fmt="int8", train=True, variables=None, x=None):
+        from faster_distributed_training_tpu.ops.quant import QuantDense
+        m = QuantDense(4, fmt=fmt, use_pallas=False)
+        if x is None:
+            rr = np.random.default_rng(7)
+            x = jnp.asarray(rr.normal(size=(6, 8)), jnp.float32)
+        if variables is None:
+            variables = m.init(jax.random.PRNGKey(0), x)
+        if train:
+            out, mut = m.apply(variables, x, mutable=["batch_stats"])
+            return m, variables, x, out, mut
+        return m, variables, x, m.apply(variables, x), None
+
+    def test_param_tree_matches_nn_dense(self):
+        from flax import linen as nn
+        from faster_distributed_training_tpu.ops.quant import QuantDense
+        x = jnp.zeros((2, 8))
+        vq = QuantDense(4, use_pallas=False).init(jax.random.PRNGKey(0), x)
+        vd = nn.Dense(4).init(jax.random.PRNGKey(0), x)
+        assert (jax.tree_util.tree_structure(vq["params"])
+                == jax.tree_util.tree_structure(vd["params"]))
+        assert [l.shape for l in jax.tree.leaves(vq["params"])] \
+            == [l.shape for l in jax.tree.leaves(vd["params"])]
+
+    def test_amax_state_updates_only_when_mutable(self):
+        m, variables, x, out, mut = self._apply()
+        h = np.asarray(mut["batch_stats"]["amax_history_x"])
+        assert h[0] == pytest.approx(float(jnp.max(jnp.abs(x))))
+        # eval (immutable batch_stats): state untouched, output finite
+        out_eval = m.apply({"params": variables["params"],
+                            "batch_stats": mut["batch_stats"]}, x)
+        assert np.all(np.isfinite(np.asarray(out_eval)))
+
+    def test_kill_switch_computes_plain_matmul(self, monkeypatch):
+        monkeypatch.setenv(Q.ENV_KILL, "0")
+        m, variables, x, out, mut = self._apply()
+        kernel = variables["params"]["kernel"]
+        bias = variables["params"]["bias"]
+        ref = x @ kernel + bias
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6)
+        # scale state is allocated (tree interchange) but never touched
+        np.testing.assert_array_equal(
+            np.asarray(mut["batch_stats"]["amax_history_x"]),
+            np.zeros(16, np.float32))
+
+    def test_tuple_features_matches_dense_general_tree(self):
+        from flax import linen as nn
+        from faster_distributed_training_tpu.ops.quant import QuantDense
+        x = jnp.zeros((2, 5, 8))
+        vq = QuantDense((3, 2, 4), use_pallas=False).init(
+            jax.random.PRNGKey(0), x)
+        vd = nn.DenseGeneral((3, 2, 4), axis=-1).init(
+            jax.random.PRNGKey(0), x)
+        assert [l.shape for l in jax.tree.leaves(vq["params"])] \
+            == [l.shape for l in jax.tree.leaves(vd["params"])]
+        out = QuantDense((3, 2, 4), use_pallas=False).apply(
+            vq, jnp.ones((2, 5, 8)), mutable=["batch_stats"])[0]
+        assert out.shape == (2, 5, 3, 2, 4)
+
+
+class TestBuildModelRouting:
+    def _cfg(self, **kw):
+        base = dict(model="transformer", dataset="synthetic",
+                    num_classes=4, batch_size=8, seq_len=16, n_layers=1,
+                    d_model=16, d_ff=32, n_heads=2, precision="fp32",
+                    attention="dense", quant="int8")
+        base.update(kw)
+        return TrainConfig(**base)
+
+    def test_quant_policy_reaches_model_off_tpu_reference(self):
+        from faster_distributed_training_tpu.cli import build_model
+        m = build_model(self._cfg(), vocab_size=100)
+        assert m.quant is not None and m.quant.fmt == "int8"
+        # CPU: the designed path is the XLA reference GEMMs
+        assert m.quant.use_pallas is False
+
+    def test_tp_mesh_falls_back_to_reference_warned(self, devices8):
+        from faster_distributed_training_tpu.cli import build_model
+        from faster_distributed_training_tpu.parallel import make_mesh
+        mesh = make_mesh(("dp", "tp"), (4, 2))
+        with pytest.warns(UserWarning,
+                          match="cannot partition over the tp axis"):
+            m = build_model(self._cfg(), vocab_size=100, mesh=mesh)
+        assert m.quant is not None
+        assert m.quant.use_pallas is False   # quantization STAYS ON
+
+    def test_tp_mesh_quant_step_trains(self, devices8):
+        """The degraded-loudly path actually TRAINS: on a dp4 x tp2
+        mesh the quantized GEMMs run as XLA-reference dots (which
+        partition like any dot) with tp-sharded kernels, and the amax
+        state still updates."""
+        import warnings as _w
+
+        from faster_distributed_training_tpu.cli import build_model
+        from faster_distributed_training_tpu.optim import build_optimizer
+        from faster_distributed_training_tpu.parallel import make_mesh
+        from faster_distributed_training_tpu.parallel.placement import (
+            make_put_batch, shard_train_state, train_state_shardings)
+        from faster_distributed_training_tpu.train import (
+            create_train_state, make_train_step)
+
+        cfg = self._cfg(batch_size=8, n_heads=2, optimizer="sgd")
+        mesh = make_mesh(("dp", "tp"), (4, 2))
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            model = build_model(cfg, vocab_size=100, mesh=mesh)
+        rng = jax.random.PRNGKey(0)
+        sample = jnp.zeros((8, 16), jnp.int32)
+        tx, _ = build_optimizer(cfg, steps_per_epoch=2)
+        state = create_train_state(model, tx, sample, rng,
+                                   init_kwargs={"train": True})
+        shardings = train_state_shardings(state, mesh, cfg)
+        rr = np.random.default_rng(0)
+        with mesh:
+            state = shard_train_state(state, mesh, cfg,
+                                      shardings=shardings)
+            batch = make_put_batch(mesh)({
+                "tokens": rr.integers(0, 100, (8, 16)).astype(np.int32),
+                "token_types": np.zeros((8, 16), np.int32),
+                "mask": np.ones((8, 16), np.int32),
+                "label": rr.integers(0, 4, (8,)).astype(np.int32)})
+            step = jax.jit(make_train_step(cfg, shardings))
+            state, metrics = step(state, batch)
+            state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        hists = [np.asarray(l) for l in jax.tree.leaves(state.batch_stats)]
+        assert any(h.any() for h in hists)   # amax state updated on tp
+
+    def test_ffn_pallas_reroutes_to_flax_composition(self):
+        from faster_distributed_training_tpu.cli import build_model
+        with pytest.warns(UserWarning, match="does not compose"):
+            m = build_model(self._cfg(ffn_impl="pallas"), vocab_size=100)
+        assert m.ffn_impl == "flax" and m.quant is not None
+
+    def test_kill_switch_warns_at_build(self, monkeypatch):
+        from faster_distributed_training_tpu.cli import build_model
+        monkeypatch.setenv(Q.ENV_KILL, "0")
+        with pytest.warns(UserWarning, match="FDT_QUANT=0"):
+            build_model(self._cfg(), vocab_size=100)
+
+    def test_resnet_quant_warns_and_ignores(self):
+        from faster_distributed_training_tpu.cli import build_model
+        with pytest.warns(UserWarning, match="only wired for the "
+                                             "transformer"):
+            build_model(self._cfg(model="resnet18", dataset="synthetic",
+                                  num_classes=10))
+
+    def test_tricks_off_disables_quant(self):
+        from faster_distributed_training_tpu.config import resolve_tricks
+        assert resolve_tricks(self._cfg(tricks="off")).quant == "none"
+
+
+# -- e2e: the full transformer training path on CPU ----------------------
+
+def _quant_cfg(tmp, **kw):
+    """Tiny transformer run_training config (the test_fused_dispatch
+    twin): 8 steps/epoch x 2 epochs, reference-fallback quant GEMMs."""
+    base = dict(model="transformer", dataset="synthetic",
+                num_classes=4, batch_size=8, seq_len=16, n_layers=1,
+                d_model=16, d_ff=32, n_heads=2, epochs=2,
+                subset_stride=64, optimizer="sgd", precision="fp32",
+                plot=False, workers=2, log_every=0, donate=False,
+                quant="int8", checkpoint_dir=str(tmp))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _quant_histories(state):
+    """Every amax-history leaf of the train state, path-sorted."""
+    leaves = jax.tree_util.tree_leaves_with_path(state.batch_stats)
+    hists = [(jax.tree_util.keystr(p), np.asarray(l)) for p, l in leaves
+             if "amax_history" in jax.tree_util.keystr(p)]
+    assert hists, "no quant scale state in batch_stats"
+    return hists
+
+
+@pytest.fixture(scope="module")
+def int8_reference(tmp_path_factory):
+    """Uninterrupted K=1 int8 run — the baseline the K=4 and
+    kill-at-N variants must reproduce bitwise, scale state included."""
+    from faster_distributed_training_tpu.cli import run_training
+    tmp = tmp_path_factory.mktemp("q_int8_ref")
+    return run_training(_quant_cfg(tmp), log=lambda *_: None)["state"]
+
+
+class TestQuantTrainingE2E:
+    def test_int8_full_path_runs_and_tracks_scales(self, int8_reference):
+        state = int8_reference
+        assert int(state.step) == 16
+        for _path, h in _quant_histories(state):
+            assert np.all(np.isfinite(h))
+        # the x/w histories actually filled (16 steps > the window is
+        # not required — just that step amaxes landed)
+        assert any(h[0] > 0 for _p, h in _quant_histories(state))
+
+    def test_fp8_full_path_runs(self, tmp_path):
+        from faster_distributed_training_tpu.cli import run_training
+        out = run_training(_quant_cfg(tmp_path, quant="fp8", epochs=1),
+                           log=lambda *_: None)
+        assert int(out["state"].step) == 8
+        assert np.isfinite(out["history"]["train_loss"][-1])
+        _quant_histories(out["state"])
+
+    def test_k4_bitwise_equals_k1_scale_state_included(
+            self, int8_reference, tmp_path):
+        """ISSUE acceptance: quant-scale state bitwise-reproducible
+        across K in {1,4} fused dispatch — the amax histories ride the
+        scan carry exactly like the loss-scale state."""
+        from faster_distributed_training_tpu.cli import run_training
+        got = run_training(
+            _quant_cfg(tmp_path, steps_per_dispatch=4,
+                       data_path="resident"),
+            log=lambda *_: None)["state"]
+        ref = int8_reference
+        assert int(got.step) == int(ref.step) == 16
+        _assert_tree_equal(got.params, ref.params)
+        _assert_tree_equal(got.batch_stats, ref.batch_stats)
+        _assert_tree_equal(got.opt_state, ref.opt_state)
+
+    def test_killed_k4_quant_run_resumes_bitwise(self, int8_reference,
+                                                 tmp_path, monkeypatch):
+        """ISSUE acceptance: kill-at-N resume lands bitwise on the
+        uninterrupted run, quant-scale state included (the histories
+        are checkpointed with batch_stats and replayed exactly)."""
+        from faster_distributed_training_tpu.cli import run_training
+        from faster_distributed_training_tpu.resilience import faults
+        monkeypatch.setenv(faults.ENV_DIE, "6")   # dies inside dispatch 2
+        got = run_training(
+            _quant_cfg(tmp_path, steps_per_dispatch=4,
+                       data_path="resident", checkpoint_every=4,
+                       supervise=True),
+            log=lambda *_: None)
+        ref = int8_reference
+        assert int(got["state"].step) == int(ref.step) == 16
+        assert got["goodput_restarts"] == 1
+        _assert_tree_equal(got["state"].params, ref.params)
+        _assert_tree_equal(got["state"].batch_stats, ref.batch_stats)
+        _assert_tree_equal(got["state"].opt_state, ref.opt_state)
+
+
+class TestAccuracyPin:
+    """The ACCURACY.md ±0.3% protocol at CPU scale: the quantized modes
+    must land final eval accuracy within 0.3 percentage points of the
+    bf16-path run on the same learnable synthetic AG News task (the
+    demonstrated-fast adamw pairing, ACCURACY.md 'transformer' section).
+    The task is chosen so the full-precision arm converges cleanly —
+    the pin then tests that quantization does not move the endpoint."""
+
+    @staticmethod
+    def _acc(tmp, quant):
+        # calibrated (this round, CPU, the suite's x64/8-device flags):
+        # all three arms reach test_acc 0.998-1.000 by epoch 3 — chance
+        # ~0.3 -> ~0.99 at epoch 2 -> saturation — so the ±0.3 pp pin
+        # compares converged endpoints, not mid-trajectory noise (the
+        # test_integration learnability precedent: stride 1 + constant
+        # lr, mixup/dropout regularizers off — this harness is about
+        # the quantized GEMM math, which is exactly what remains
+        # different between arms).  mesh pinned to ONE device: the dp=8
+        # virtual mesh would scale the lr x8 (run_training's xN rule)
+        # past this config's stable range, and single-device is also 3x
+        # faster on this CPU harness.
+        from faster_distributed_training_tpu.cli import run_training
+        cfg = TrainConfig(
+            model="transformer", dataset="synthetic", num_classes=4,
+            batch_size=32, seq_len=32, n_layers=2, d_model=64, d_ff=128,
+            n_heads=4, epochs=3, subset_stride=1, optimizer="adamw",
+            schedule="constant", lr=2e-3, precision="fp32", quant=quant,
+            alpha=0.0, dropout_impl="none", mesh_shape=(1,), plot=False,
+            workers=2, log_every=0, donate=False,
+            checkpoint_dir=str(tmp))
+        out = run_training(cfg, log=lambda *_: None)
+        return float(out["history"]["test_acc"][-1])
+
+    @pytest.fixture(scope="class")
+    def bf16_path_acc(self, tmp_path_factory):
+        return self._acc(tmp_path_factory.mktemp("acc_none"), "none")
+
+    def test_int8_final_eval_within_pin(self, bf16_path_acc,
+                                        tmp_path_factory):
+        acc = self._acc(tmp_path_factory.mktemp("acc_int8"), "int8")
+        assert bf16_path_acc >= 0.9, "harness task must be learnable"
+        assert abs(acc - bf16_path_acc) <= 0.003 + 1e-9
+
+    def test_fp8_final_eval_within_pin(self, bf16_path_acc,
+                                       tmp_path_factory):
+        acc = self._acc(tmp_path_factory.mktemp("acc_fp8"), "fp8")
+        assert abs(acc - bf16_path_acc) <= 0.003 + 1e-9

@@ -497,3 +497,77 @@ class TestReportScript:
         text = mod.render({"directory": "/tmp/x", "summary": summary})
         rows = [ln for ln in text.splitlines() if "host " in ln]
         assert [r.split()[1] for r in rows] == ["0", "2", "10"]
+
+
+class TestStepSampling:
+    """--telemetry_every N (r13 satellite): the r12 note names
+    per-dispatch time.monotonic pressure under async dispatch as the
+    first suspect if telemetry_overhead_pct ever fails on live TPU —
+    sampling every Nth dispatch is the landed mitigation.  Sampling
+    drops whole records (surviving ones keep their TRUE step numbers);
+    compile-marked first dispatches are always kept."""
+
+    def test_every_n_keeps_true_step_numbers(self, tmp_path):
+        rec = TelemetryRecorder(str(tmp_path), process_index=0,
+                                process_count=1, step_every=3,
+                                log=lambda *_: None)
+        rec.record_step(1, 0, 1, 1, 1.0, 1.0, 4, compile_=True)
+        for i in range(2, 13):
+            rec.record_step(i, 0, i, 1, 1.0, 1.0, 4)
+        rec.record_event("epoch", epoch=0)   # events are never sampled
+        rec.close()
+        recs = _read_jsonl(os.path.join(str(tmp_path),
+                                        "host_00000.jsonl"))
+        steps = [r for r in recs if r["kind"] == "step"]
+        assert steps[0]["step"] == 1 and steps[0].get("compile")
+        # every 3rd dispatch thereafter, true global steps preserved
+        assert [r["step"] for r in steps[1:]] == [3, 6, 9, 12]
+        assert any(r["kind"] == "epoch" for r in recs)
+
+    def test_compile_records_survive_sampling(self, tmp_path):
+        rec = TelemetryRecorder(str(tmp_path), process_index=0,
+                                process_count=1, step_every=100,
+                                log=lambda *_: None)
+        for i in range(1, 6):
+            rec.record_step(i, 0, i, 1, 1.0, 1.0, 4, compile_=(i == 2))
+        rec.close()
+        steps = [r for r in _read_jsonl(os.path.join(
+            str(tmp_path), "host_00000.jsonl")) if r["kind"] == "step"]
+        # only the compile-marked dispatch survives a 1-in-100 rate
+        assert [r["step"] for r in steps] == [2]
+        assert steps[0]["compile"] is True
+
+    def test_build_telemetry_wires_the_flag(self, tmp_path):
+        cfg = TrainConfig(checkpoint_dir=str(tmp_path),
+                          telemetry_every=4)
+        tel = build_telemetry(cfg, log=lambda *_: None)
+        assert tel.recorder.step_every == 4
+        tel.close()
+
+    def test_default_records_every_dispatch(self, tmp_path):
+        rec = TelemetryRecorder(str(tmp_path), process_index=0,
+                                process_count=1, log=lambda *_: None)
+        for i in range(1, 6):
+            rec.record_step(i, 0, i, 1, 1.0, 1.0, 4)
+        rec.close()
+        steps = [r for r in _read_jsonl(os.path.join(
+            str(tmp_path), "host_00000.jsonl")) if r["kind"] == "step"]
+        assert [r["step"] for r in steps] == [1, 2, 3, 4, 5]
+
+    def test_next_step_kept_predicts_record_decisions(self, tmp_path):
+        """The Trainer consults next_step_kept BEFORE a dispatch to
+        skip the telemetry-only clock reads (review pass: sampling at
+        the recorder layer alone would keep 100% of the monotonic
+        pressure) — the prediction must agree exactly with what
+        record_step then keeps."""
+        rec = TelemetryRecorder(str(tmp_path), process_index=0,
+                                process_count=1, step_every=3,
+                                log=lambda *_: None)
+        preds = []
+        for i in range(1, 10):
+            preds.append(rec.next_step_kept())
+            rec.record_step(i, 0, i, 1, 1.0, 1.0, 4)
+        rec.close()
+        steps = [r["step"] for r in _read_jsonl(os.path.join(
+            str(tmp_path), "host_00000.jsonl")) if r["kind"] == "step"]
+        assert steps == [i for i, p in zip(range(1, 10), preds) if p]
