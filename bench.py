@@ -637,6 +637,146 @@ def timed_restart_mttr() -> dict:
             "die_at": die_at}
 
 
+def timed_restart_slice_mttr() -> dict:
+    """Slice-recovery MTTR arm (r14 elastic-recovery PR): a simulated
+    2-slice pod (two host threads, one slice each, shared directory —
+    the tier-1 simulation seam), slice 1 killed by a deterministic
+    injected crash.  The survivor HOLDS at its dispatch boundary
+    (await_readmission) instead of restarting; the killed slice
+    restarts, rejoins the same generation, restores, catches up and is
+    re-admitted.  Reports restart_slice_mttr_s = (detect + hold +
+    restore) / readmissions with the components beside it — the
+    slice-granular sibling of restart_mttr_s (whose backoff+rollback
+    the surviving slice no longer pays).  Training is tiny by design:
+    the arm measures the recovery machinery, not the workload."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from faster_distributed_training_tpu.config import TrainConfig
+    from faster_distributed_training_tpu.models import Transformer
+    from faster_distributed_training_tpu.optim import build_optimizer
+    from faster_distributed_training_tpu.resilience import (
+        AsyncCheckpointManager, FaultPlan, GoodputTracker, PodCoordinator,
+        Supervisor)
+    from faster_distributed_training_tpu.train import (create_train_state,
+                                                       make_train_step)
+
+    cfg = TrainConfig(model="transformer", dataset="agnews", num_classes=4,
+                      batch_size=4, seq_len=8, optimizer="sgd",
+                      precision="fp32", epochs=1, donate=False)
+    model = Transformer(n_class=4, vocab=32, n_layers=1, h=2, d_model=16,
+                        d_ff=32, d_hidden=16, maxlen=8)
+    tx, _ = build_optimizer(cfg, steps_per_epoch=2)
+    state0 = create_train_state(model, tx, jnp.zeros((4, 8), jnp.int32),
+                                jax.random.PRNGKey(0),
+                                init_kwargs={"train": True})
+    batch = {"tokens": _np.random.default_rng(0).integers(
+                 0, 32, size=(4, 8)).astype(_np.int32),
+             "label": _np.arange(4, dtype=_np.int32) % 4}
+    step_fn = jax.jit(make_train_step(cfg))
+    total, every = 12, 4
+    die_at = int(os.environ.get("FDT_BENCH_SLICE_MTTR_DIE_AT", "6"))
+    d = tempfile.mkdtemp(prefix="fdt_bench_slice_mttr_")
+    goodputs = [GoodputTracker().start() for _ in range(2)]
+    # loose lockstep until the kill (then the barrier is aborted and
+    # both sides run free), plus a small per-step pace so the
+    # survivor's FAIL-marker observation is deterministic-ish
+    barrier = threading.Barrier(2)
+
+    def host(pi, faults):
+        coord = PodCoordinator(
+            os.path.join(d, "_pod"), process_index=pi, process_count=2,
+            sync_every=1, peer_timeout_s=30.0, slice_index=pi,
+            slice_count=2, readmit_timeout_s=60.0,
+            goodput=goodputs[pi], log=lambda *_: None)
+        mgr = AsyncCheckpointManager(
+            d, every_steps=every, process_index=pi, process_count=2,
+            shard_owner=((lambda sh: sh.replica_id == 0) if pi == 0
+                         else (lambda sh: False)),
+            commit_timeout_s=15.0,
+            step_gather_fn=coord.gather_restored_step,
+            goodput=goodputs[pi], log=lambda *_: None)
+        coord.drain_fn = mgr.wait
+        sup = Supervisor(max_restarts=3, backoff_base=0.01,
+                         goodput=goodputs[pi], log=lambda *_: None,
+                         coordinator=coord)
+        progress = {"step": 0}
+
+        def attempt(_i):
+            try:
+                st, start = state0, 0
+                got = mgr.restore_latest(st)
+                if got is not None:
+                    st, meta = got
+                    start = int(meta["step"])
+                progress["step"] = start
+                if coord.rejoining:
+                    coord.rejoin_sync(start)
+                with coord.watch_steps():
+                    for i in range(start + 1, total + 1):
+                        try:
+                            barrier.wait(timeout=30.0)
+                        except threading.BrokenBarrierError:
+                            pass
+                        st, _m = step_fn(st, batch)
+                        time.sleep(0.01)
+                        progress["step"] = i
+                        if faults is not None:
+                            faults.on_step(i)
+                        coord.check(i)
+                        align = coord.consume_cadence_align()
+                        if align is not None:
+                            mgr.align_cadence(align)
+                        if not coord.saves_suspended:
+                            mgr.maybe_save(st, i)
+                mgr.wait()
+                return st
+            except BaseException:
+                barrier.abort()
+                raise
+        try:
+            return sup.run(attempt, lambda: progress["step"])
+        finally:
+            mgr.close()
+            coord.close()
+
+    errors = {}
+
+    def body(pi, faults):
+        try:
+            host(pi, faults)
+        except BaseException as e:          # pragma: no cover - reported
+            errors[pi] = repr(e)
+
+    threads = [
+        threading.Thread(target=body, args=(0, None), daemon=True),
+        threading.Thread(target=body, args=(1, FaultPlan(die_at=die_at)),
+                         daemon=True)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    shutil.rmtree(d, ignore_errors=True)
+    s0, s1 = goodputs[0].summary(), goodputs[1].summary()
+    readmits = int(s0.get("slice_readmissions", 0))
+    detect = float(s0.get("detect_s", 0.0))
+    hold = float(s0.get("readmission_hold_s", 0.0))
+    restore = float(s1.get("restore_s", 0.0))
+    return {"restart_slice_mttr_s": round(
+                (detect + hold + restore) / max(readmits, 1), 3),
+            "detect_s": round(detect, 3), "hold_s": round(hold, 3),
+            "restore_s": round(restore, 3),
+            "readmissions": readmits,
+            "fallbacks": (int(s0.get("pod_fallback_restarts", 0))
+                          + int(s1.get("pod_fallback_restarts", 0))),
+            "errors": errors, "die_at": die_at}
+
+
 def timed_fused(model: str, k: int, bs: int, seq: int, steps: int) -> dict:
     """K-step fused dispatch arm (r8 tentpole): the full train program on
     DEVICE-RESIDENT synthetic data, K steps per dispatch
@@ -962,6 +1102,7 @@ PRODUCED_METRIC_PATTERNS = (
     "ckpt_*_median_step_ms", "ckpt_*_mean_step_ms",
     "ckpt_*_blocking_ms_per_save", "ckpt_*_overhead_pct",
     "restart_mttr_s", "restart_mttr_*_s",
+    "restart_slice_mttr_s", "restart_slice_mttr_*_s",
     "telem_on_median_step_ms", "telem_off_median_step_ms",
     "telemetry_overhead_pct",
     "transformer_bs256_seq256_quant_off_step_ms",   # r13 quant A/B
@@ -1283,6 +1424,11 @@ def main() -> None:
         # r10 resilience arm: one supervised crash-and-recover cycle,
         # MTTR decomposition from the goodput tracker
         print(json.dumps(timed_restart_mttr()))
+        return
+    if child == "restart_slice_mttr":
+        # r14 elastic-recovery arm: simulated 2-slice pod, one slice
+        # killed and re-admitted; detect + hold + restore decomposition
+        print(json.dumps(timed_restart_slice_mttr()))
         return
     if child.startswith("telem_"):
         # r12 observability arm: per-dispatch recorder on vs off, one
@@ -1606,6 +1752,19 @@ def main() -> None:
                 record["restart_mttr_restore_s"] = mt["restore_s"]
                 record["restart_mttr_backoff_s"] = mt["backoff_s"]
                 record["restart_mttr_detect_s"] = mt["detect_s"]
+            # Slice-recovery MTTR (r14 elastic-recovery arm): one
+            # slice killed and RE-ADMITTED while the other holds —
+            # detect + hold + restore per readmission (see
+            # timed_restart_slice_mttr); the whole-pod backoff and the
+            # survivor's rollback replay are exactly the costs this
+            # path removes, so the two headlines are directly
+            # comparable.
+            smt = _run_child("restart_slice_mttr")
+            if smt and smt.get("readmissions"):
+                record["restart_slice_mttr_s"] = smt["restart_slice_mttr_s"]
+                record["restart_slice_mttr_detect_s"] = smt["detect_s"]
+                record["restart_slice_mttr_hold_s"] = smt["hold_s"]
+                record["restart_slice_mttr_restore_s"] = smt["restore_s"]
         # Telemetry-overhead arm (r12 observability tentpole): the
         # per-dispatch recorder must be free — on-vs-off measured N>=5
         # times INTERLEAVED (the r6 noise protocol: alternating children
@@ -1866,6 +2025,7 @@ def _essentials(record: dict) -> dict:
             "tricks_speedup_x", "ckpt_async_overhead_pct",
             "ckpt_async_amortized_overhead_pct",
             "ckpt_async_sharded_overhead_pct", "restart_mttr_s",
+            "restart_slice_mttr_s",
             "telemetry_overhead_pct",
             "transformer_bs256_seq256_quant_off_step_ms",
             "transformer_bs256_seq256_int8_step_ms",

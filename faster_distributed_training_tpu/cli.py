@@ -897,7 +897,9 @@ def run_training(cfg: TrainConfig,
             resumes — re-running a COMPLETED run's command is an
             (intentional) idempotent no-op; point --checkpoint_dir at a
             fresh directory for a fresh run."""
-            st, ep, sie = state, start_epoch, 0
+            st, ep, sie, restored_step = state, start_epoch, 0, 0
+            rejoining = (res is not None and res.coordinator is not None
+                         and res.coordinator.rejoining)
             if res is not None and res.manager is not None:
                 prev_step = trainer.global_step
                 got = res.manager.restore_latest(st)
@@ -908,7 +910,7 @@ def run_training(cfg: TrainConfig,
                     sie = int(meta.get("step_in_epoch", 0))
                     trainer.best_acc = float(meta.get("best_acc",
                                                       trainer.best_acc))
-                    step = int(meta.get("step", 0))
+                    restored_step = step = int(meta.get("step", 0))
                     log(f"[resume] restored step-cadence checkpoint: "
                         f"step {step} (epoch {ep}, batch {sie})")
                     if restart_index > 0 and prev_step > step:
@@ -921,12 +923,20 @@ def run_training(cfg: TrainConfig,
                                 "rollback_lost_s",
                                 (prev_step - step)
                                 * s["productive_s"] / s["steps"])
-                elif cfg.supervise and restart_index == 0:
+                elif cfg.supervise and restart_index == 0 and not rejoining:
                     # seed a step-0 restore point so a crash before the
                     # first cadence save is still recoverable (the donated
-                    # live state can't serve as one)
+                    # live state can't serve as one).  Never while
+                    # REJOINING: the parked survivors are not taking this
+                    # tick, so its commit barrier could only time out.
                     res.manager.save(st, 0, epoch=ep, step_in_epoch=0,
                                      best_acc=trainer.best_acc)
+            if rejoining:
+                # rejoining slice (r14): agree the catch-up target with
+                # the parked survivors now — when the restored step
+                # already IS the target, the readiness handshake
+                # completes here, before the dispatch loop re-enters
+                res.coordinator.rejoin_sync(restored_step)
             return trainer.fit(st, train_loader, eval_loader,
                                ckpt_name=ckpt_name, start_epoch=ep,
                                start_step_in_epoch=sie)

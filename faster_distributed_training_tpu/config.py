@@ -270,6 +270,24 @@ class TrainConfig:
                                       # exceed the worst-case dispatch
                                       # (re)compile, which only the operator
                                       # knows)
+    storage_backend: str = "posix"    # durable-write medium for the
+                                      # resilience stack (markers, sharded
+                                      # checkpoints, retention): "posix"
+                                      # (shared fs, today's semantics),
+                                      # "fake_object_store" (rename-free
+                                      # object semantics under
+                                      # <checkpoint_dir>/_objects — the GCS
+                                      # stand-in), or "gs://bucket[/prefix]"
+                                      # (resilience/storage.py)
+    readmit_timeout_s: float = 60.0   # slice-granular elastic recovery
+                                      # (multi-slice pods, FDT_SLICE_COUNT):
+                                      # how long surviving slices hold at a
+                                      # dispatch boundary for a failed
+                                      # slice's restart + rejoin before
+                                      # falling back to a whole-pod restart.
+                                      # 0 = disable re-admission (every
+                                      # failure restarts the whole pod, the
+                                      # r10 behavior)
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
@@ -442,6 +460,18 @@ def build_parser(prog: str = "fdt",
                         "a FAIL marker and hard-abort so the pod converges "
                         "on a restart (0 = off; must exceed the worst-case "
                         "dispatch (re)compile time)")
+    p.add_argument("--storage_backend", default=d.storage_backend,
+                   help="durable-write medium for resilience markers / "
+                        "sharded checkpoints / retention: posix (default), "
+                        "fake_object_store (rename-free object semantics "
+                        "under <checkpoint_dir>/_objects), or "
+                        "gs://bucket[/prefix]")
+    p.add_argument("--readmit_timeout_s", default=d.readmit_timeout_s,
+                   type=float,
+                   help="multi-slice elastic recovery (FDT_SLICE_COUNT): "
+                        "how long surviving slices hold for a failed "
+                        "slice's restart + re-admission before falling "
+                        "back to a whole-pod restart (0 = always whole-pod)")
     p.add_argument("--debug", action="store_true",
                    help="per-epoch NGD Fisher invariant self-tests")
     p.add_argument("--data_path", default=d.data_path,
@@ -567,6 +597,8 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         preempt_sync_every=args.preempt_sync_every,
         peer_timeout_s=args.peer_timeout_s,
         step_timeout_s=args.step_timeout_s,
+        storage_backend=args.storage_backend,
+        readmit_timeout_s=args.readmit_timeout_s,
         data_path=args.data_path,
         resident_layout=args.resident_layout,
         steps_per_dispatch=args.steps_per_dispatch,

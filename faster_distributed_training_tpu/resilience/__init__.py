@@ -52,10 +52,17 @@ class Preempted(Exception):
         self.step = step
 
 
+# storage FIRST: it is dependency-free and both coordinator/manager and
+# train/checkpoint.py import it — binding it on the package object
+# before the manager->checkpoint->storage import cycle re-enters this
+# partially-initialized module is what keeps that cycle resolvable
+from faster_distributed_training_tpu.resilience import storage  # noqa: E402,F401,E501
+from faster_distributed_training_tpu.resilience.storage import (  # noqa: E402,F401,E501
+    FakeObjectStoreBackend, PosixBackend, StorageBackend, build_backend)
 from faster_distributed_training_tpu.resilience.goodput import (  # noqa: E402,F401,E501
     GoodputTracker)
 from faster_distributed_training_tpu.resilience.coordinator import (  # noqa: E402,F401,E501
-    PeerFailure, PodCoordinator, StepTimeout, pod_identity)
+    PeerFailure, PodCoordinator, StepTimeout, pod_identity, slice_identity)
 from faster_distributed_training_tpu.resilience.manager import (  # noqa: E402,F401,E501
     AsyncCheckpointManager, RestoreDivergence)
 from faster_distributed_training_tpu.resilience.preemption import (  # noqa: E402,F401,E501
@@ -86,6 +93,9 @@ class Resilience:
     pod_index: int = 0
     pod_count: int = 1
     pod_simulated: bool = False
+    slice_index: int = 0
+    slice_count: int = 1
+    backend: Optional[StorageBackend] = None
 
     def close(self) -> None:
         if self.manager is not None:
@@ -115,8 +125,18 @@ def build_resilience(cfg, log: Callable[[str], None] = print
     identity (host 0 owns the replica-0 shards, peers own none — every
     simulated process computes the identical full state) and the
     coordinator's marker-file allgather replaces the jax collective in
-    the restore step-agreement."""
+    the restore step-agreement.
+
+    Storage + slices (r14): ``--storage_backend`` selects the durable
+    medium every marker/sharded-checkpoint write rides
+    (``resilience/storage.py`` — posix / fake_object_store / gs://);
+    ``FDT_SLICE_INDEX``/``FDT_SLICE_COUNT`` partition the pod into
+    slices and ``--readmit_timeout_s`` arms slice-granular elastic
+    re-admission on the coordinator (surviving slices hold while a
+    failed slice restarts and rejoins; whole-pod restart remains the
+    fallback)."""
     pi, pc, simulated = pod_identity()
+    si, sc, _slice_sim = slice_identity(process_index=pi, process_count=pc)
     faults = FaultPlan.from_env(process_index=pi)
     cadence = bool(cfg.checkpoint_every or cfg.checkpoint_every_secs)
     step_timeout = float(getattr(cfg, "step_timeout_s", 0.0) or 0.0)
@@ -129,6 +149,14 @@ def build_resilience(cfg, log: Callable[[str], None] = print
             "will block forever")
     if not (cadence or cfg.supervise or faults is not None):
         return None
+    # the storage backend every resilience-critical durable write rides
+    # (r14): markers, sharded checkpoint phases, retention.  posix =
+    # today's shared-fs semantics, byte-compatible; fake_object_store /
+    # gs:// = no-rename object semantics (multi-slice pods without a
+    # shared filesystem)
+    backend = storage.build_backend(
+        getattr(cfg, "storage_backend", "posix"), cfg.checkpoint_dir,
+        log=log)
     goodput = GoodputTracker()
     coordinator = None
     if cfg.supervise and (pc > 1 or step_timeout > 0):
@@ -138,6 +166,10 @@ def build_resilience(cfg, log: Callable[[str], None] = print
             sync_every=cfg.preempt_sync_every,
             peer_timeout_s=float(getattr(cfg, "peer_timeout_s", 60.0)),
             step_timeout_s=step_timeout,
+            slice_index=si, slice_count=sc,
+            readmit_timeout_s=float(
+                getattr(cfg, "readmit_timeout_s", 60.0)),
+            backend=backend,
             goodput=goodput, log=log)
     manager = None
     if cadence:
@@ -156,8 +188,13 @@ def build_resilience(cfg, log: Callable[[str], None] = print
                 commit_timeout_s=max(
                     2.0 * float(getattr(cfg, "peer_timeout_s", 60.0)),
                     10.0))
-            if coordinator is not None:
-                sim_kw["step_gather_fn"] = coordinator.gather_restored_step
+        if coordinator is not None and (simulated or sc > 1) and pc > 1:
+            # marker-transport restore agreement: fs-simulated pods (jax
+            # single-process per host), and REAL multi-slice pods — a
+            # jax collective across a pod with a dead/rejoining slice
+            # is exactly the thing that cannot be relied on (the
+            # slice-scoped barrier only exists on the marker transport)
+            sim_kw["step_gather_fn"] = coordinator.gather_restored_step
         manager = AsyncCheckpointManager(
             cfg.checkpoint_dir,
             # mirror the epoch-checkpoint naming (loop.py ckpt_name) so
@@ -169,10 +206,17 @@ def build_resilience(cfg, log: Callable[[str], None] = print
             every_secs=cfg.checkpoint_every_secs,
             keep=cfg.checkpoint_keep,
             async_save=cfg.checkpoint_async,
+            backend=backend,
             goodput=goodput, log=log, **sim_kw)
+    if coordinator is not None and manager is not None:
+        # survivors drain their in-flight background save before
+        # publishing a re-admission HOLD (freezes the commit frontier
+        # the rejoining slice walks — coordinator._await_readmission)
+        coordinator.drain_fn = manager.wait
     preemption = PreemptionHandler(sync_every=cfg.preempt_sync_every,
                                    log=log).install()
     return Resilience(manager=manager, preemption=preemption,
                       faults=faults, goodput=goodput,
                       coordinator=coordinator, pod_index=pi, pod_count=pc,
-                      pod_simulated=simulated)
+                      pod_simulated=simulated, slice_index=si,
+                      slice_count=sc, backend=backend)

@@ -55,13 +55,32 @@ Clock caveat: marker timestamps are host wall clocks; the detect_s
 latency derived from a PEER's marker is exact in the single-machine
 simulations and subject to NTP skew across real hosts (seconds — noise
 against multi-second detection cadences, documented rather than
-hidden)."""
+hidden).
+
+r14 — storage backend + slices: every marker read/write/list routes
+through a :class:`~faster_distributed_training_tpu.resilience.storage.
+StorageBackend`, so the ``_pod/gen_<g>/`` namespace can live on an
+object store when the pod's slices do not share a filesystem (the
+tier-1 fake object store proves the protocol needs no rename
+primitive).  ``FDT_SLICE_INDEX``/``FDT_SLICE_COUNT``
+(:func:`slice_identity`) partition the pod into slices with
+slice-qualified marker names, and a failure confined to ONE foreign
+slice no longer forces a whole-pod restart: the survivors park in a
+bounded ``await_readmission`` hold (HOLD markers carrying their step),
+the restarted slice REJOINS the incident's generation
+(``begin_attempt`` detects own-slice-only FAILs), restores through a
+slice-scoped barrier, catches up to the agreed target (max over
+survivor holds — provably >= the restored checkpoint step) and joins
+the ``RJREADY`` readiness barrier; every host then advances the
+generation in place and resumes.  Whole-pod restart remains the
+fallback for every ambiguous corner: hold/rejoin timeout, a second
+failure outside the incident slice, or rejoin-retry residue (the
+durable ``RJ_ABORT`` marker degrades everyone to the r10 protocol)."""
 
 from __future__ import annotations
 
 import os
 import re
-import shutil
 import signal
 import threading
 import time
@@ -69,13 +88,24 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from faster_distributed_training_tpu.resilience import storage as storage_mod
+
 ENV_POD_INDEX = "FDT_POD_INDEX"
 ENV_POD_COUNT = "FDT_POD_COUNT"
+ENV_SLICE_INDEX = "FDT_SLICE_INDEX"
+ENV_SLICE_COUNT = "FDT_SLICE_COUNT"
 
 _GEN_DIR = re.compile(r"^gen_(?P<gen>\d{6})$")
 # strict: the atomic writer stages `FAIL_<pi>.tmp<pid>` beside the real
-# marker — listing-based discovery must never parse those as markers
-_FAIL = re.compile(r"^FAIL_(?P<pi>\d{5})$")
+# marker — listing-based discovery must never parse those as markers.
+# Multi-slice pods qualify marker names with the slice (`FAIL_s001_00002`)
+# so a per-slice observer can partition an incident without a reverse
+# lookup; single-slice pods keep the r10 names byte-for-byte.
+_FAIL = re.compile(r"^FAIL_(?:s(?P<si>\d{3})_)?(?P<pi>\d{5})$")
+# one-per-generation rejoin-abort marker: a rejoining slice that cannot
+# complete re-admission publishes it so the parked survivors fall back
+# to a whole-pod restart immediately instead of waiting out their hold
+_RJ_ABORT = "RJ_ABORT"
 
 
 class PeerFailure(RuntimeError):
@@ -110,31 +140,52 @@ def pod_identity(env=os.environ) -> Tuple[int, int, bool]:
     return jax.process_index(), jax.process_count(), False
 
 
+def slice_identity(env=os.environ, process_index: Optional[int] = None,
+                   process_count: Optional[int] = None
+                   ) -> Tuple[int, int, bool]:
+    """(slice_index, slice_count, simulated) — the multi-SLICE seam
+    beside :func:`pod_identity` (r14).
+
+    ``FDT_SLICE_COUNT`` arms it: the pod's processes are partitioned
+    into ``slice_count`` contiguous equal blocks (process ``pi`` lives
+    on slice ``pi * slice_count // process_count`` — the layout real
+    multislice launchers use, one process range per slice) and the
+    coordinator scopes failure handling per slice: a dead slice can be
+    restarted and RE-ADMITTED while the others hold, instead of forcing
+    a whole-pod restart.  ``FDT_SLICE_INDEX`` overrides this host's own
+    derived index for exotic layouts (the derived map still names the
+    PEERS' slices, so overriding only one host inconsistently is
+    unsupported — documented, not guessed around).  Without the env,
+    (0, 1, False): single-slice, the r10 behavior byte-for-byte."""
+    raw = env.get(ENV_SLICE_COUNT)
+    if not raw:
+        return 0, 1, False
+    sc = int(raw)
+    if sc <= 1:
+        return 0, 1, False
+    if process_index is None or process_count is None:
+        pi, pc, _sim = pod_identity(env)
+        process_index = pi if process_index is None else process_index
+        process_count = pc if process_count is None else process_count
+    raw_si = env.get(ENV_SLICE_INDEX)
+    if raw_si not in (None, ""):
+        return int(raw_si), sc, True
+    return (int(process_index) * sc // max(int(process_count), 1), sc, True)
+
+
 def _write_json_atomic(path: str, obj) -> None:
-    # local copy of checkpoint._write_json_atomic (tmp + replace + fsync)
-    # so the watchdog thread can write markers without importing the
-    # orbax-heavy checkpoint module from a non-main thread mid-crash.
-    # The tmp name carries the THREAD ident too: the heartbeat is
-    # written from both the watchdog thread (every hb_interval_s) and
-    # the main thread (begin_attempt) — a pid-only tmp path would let
-    # one thread's os.replace consume the other's staged file and turn
-    # a benign overlap into FileNotFoundError
-    import json
-    tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
-    with open(tmp, "w") as f:
-        json.dump(obj, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    """Atomic marker write on the POSIX default backend — kept as a
+    module-level helper for tests that plant markers directly; the
+    coordinator itself routes every marker through its configured
+    backend (r14).  The backend's staging name carries pid AND thread
+    ident: heartbeats are written from both the watchdog thread and the
+    main thread, and a shared staging path would let one thread's
+    publish consume the other's."""
+    storage_mod.posix_backend().put_json(path, obj)
 
 
 def _read_json(path: str) -> Optional[dict]:
-    import json
-    try:
-        with open(path) as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return None
+    return storage_mod.posix_backend().read_json(path)
 
 
 class PodCoordinator:
@@ -157,7 +208,11 @@ class PodCoordinator:
                  peer_timeout_s: float = 60.0, step_timeout_s: float = 0.0,
                  hb_interval_s: float = 2.0, gather_timeout_s: float = 120.0,
                  goodput=None, log: Callable[[str], None] = print,
-                 abort_fn: Optional[Callable[[str], None]] = None):
+                 abort_fn: Optional[Callable[[str], None]] = None,
+                 slice_index: Optional[int] = None,
+                 slice_count: Optional[int] = None,
+                 readmit_timeout_s: float = 0.0,
+                 backend: Optional[storage_mod.StorageBackend] = None):
         if process_index is None or process_count is None:
             pi, pc, _sim = pod_identity()
             process_index = pi if process_index is None else process_index
@@ -165,6 +220,24 @@ class PodCoordinator:
         self.directory = os.path.abspath(directory)
         self.pi = int(process_index)
         self.pc = int(process_count)
+        # multi-slice identity (r14): slice_count>1 partitions the pod
+        # into contiguous process blocks and arms slice-granular
+        # re-admission (readmit_timeout_s>0); default = one slice, the
+        # r10 whole-pod protocol byte-for-byte
+        if slice_index is None or slice_count is None:
+            si, sc, _ssim = slice_identity(
+                process_index=self.pi, process_count=self.pc)
+            slice_index = si if slice_index is None else slice_index
+            slice_count = sc if slice_count is None else slice_count
+        self.si = int(slice_index)
+        self.sc = max(int(slice_count), 1)
+        self.readmit_timeout_s = float(readmit_timeout_s)
+        # every marker read/write/list routes through the storage
+        # backend — with per-slice filesystems the backend (an object
+        # store, or its tier-1 fake) IS what makes the `_pod/gen_<g>/`
+        # namespace span slices
+        self.backend = backend if backend is not None \
+            else storage_mod.posix_backend()
         self.sync_every = max(int(sync_every), 1)
         self.peer_timeout_s = float(peer_timeout_s)
         self.step_timeout_s = float(step_timeout_s)
@@ -173,6 +246,15 @@ class PodCoordinator:
         self._goodput = goodput
         self._log = log
         self._abort = abort_fn or self._default_abort
+        # slice re-admission state (all main-thread only)
+        self._rejoining = False
+        self._rejoin_target: Optional[int] = None
+        self._release_target: Optional[int] = None
+        self._align_target: Optional[int] = None
+        # set by the resilience wiring to the checkpoint manager's
+        # ``wait`` — a survivor drains its in-flight background save
+        # before publishing HOLD (see _await_readmission)
+        self.drain_fn: Optional[Callable[[], None]] = None
         # EXIT markers older than this coordinator are a PREVIOUS run's
         # completions (the same checkpoint_dir reused to train further)
         # and must not poison this run — see _exited_peers
@@ -195,9 +277,31 @@ class PodCoordinator:
     def _gen_path(self, gen: int) -> str:
         return os.path.join(self.directory, f"gen_{gen:06d}")
 
+    def slice_of(self, pi: int) -> int:
+        """The slice a pod process lives on: contiguous equal blocks
+        (the :func:`slice_identity` layout).  Own index may be
+        env-overridden; peers are always the derived map."""
+        if pi == self.pi:
+            return self.si
+        if self.sc <= 1:
+            return 0
+        return int(pi) * self.sc // self.pc
+
+    def _slice_members(self, si: int) -> List[int]:
+        return [p for p in range(self.pc) if self.slice_of(p) == si]
+
+    def _marker_name(self, kind: str, pi: int) -> str:
+        """Slice-qualified on multi-slice pods (``FAIL_s001_00002``),
+        the bare r10 form otherwise — byte-compatible with existing
+        coordination directories."""
+        if self.sc > 1:
+            return f"{kind}_s{self.slice_of(pi):03d}_{pi:05d}"
+        return f"{kind}_{pi:05d}"
+
     def _marker(self, kind: str, pi: int, gen_dir: Optional[str] = None
                 ) -> str:
-        return os.path.join(gen_dir or self._require_gen(), f"{kind}_{pi:05d}")
+        return os.path.join(gen_dir or self._require_gen(),
+                            self._marker_name(kind, pi))
 
     def _require_gen(self) -> str:
         if self._gen_dir is None:
@@ -208,28 +312,23 @@ class PodCoordinator:
         return self._gen_dir
 
     def _generations(self) -> List[Tuple[int, str]]:
-        try:
-            names = os.listdir(self.directory)
-        except OSError:
-            return []
-        out = []
-        for n in names:
-            m = _GEN_DIR.match(n)
+        """Generation dirs discovered through the backend's one-level
+        entry listing (an object store has no directories — a
+        generation exists once any marker lands in it, which
+        begin_attempt's immediate heartbeat guarantees)."""
+        gens = set()
+        for name in self.backend.list_entries(self.directory):
+            m = _GEN_DIR.match(name)
             if m:
-                out.append((int(m.group("gen")),
-                            os.path.join(self.directory, n)))
-        return sorted(out)
+                gens.add(int(m.group("gen")))
+        return [(g, self._gen_path(g)) for g in sorted(gens)]
 
     def _failures(self, gen_dir: str) -> Dict[int, dict]:
         out = {}
-        try:
-            names = os.listdir(gen_dir)
-        except OSError:
-            return out
-        for n in names:
+        for n in self.backend.list_entries(gen_dir):
             m = _FAIL.match(n)
             if m:
-                out[int(m.group("pi"))] = _read_json(
+                out[int(m.group("pi"))] = self.backend.read_json(
                     os.path.join(gen_dir, n)) or {}
         return out
 
@@ -238,39 +337,72 @@ class PodCoordinator:
     def begin_attempt(self) -> int:
         """Enter the pod's current generation: 1 + the newest generation
         holding any FAIL marker (0 on a clean directory).  Every host
-        computes this from the same shared-fs state, so hosts that
+        computes this from the same shared-backend state, so hosts that
         restarted for DIFFERENT reasons (own crash vs observed peer
         failure) still converge on one generation — and a fresh process
         launched into an old incident's directory joins at the incident's
-        next generation rather than rewinding the counter."""
-        g = 0
+        next generation rather than rewinding the counter.
+
+        Slice re-admission (r14): when the newest incident's FAIL
+        markers are confined to THIS host's slice and re-admission is
+        armed, the restarting slice does NOT advance the generation —
+        it re-enters the incident's generation in rejoin mode
+        (``rejoining`` True) while the surviving slices are parked in
+        their ``await_readmission`` hold; :meth:`rejoin_sync` completes
+        the handshake.  A second rejoin attempt in the same generation
+        (own rejoin residue found) aborts to the whole-pod path via the
+        durable ``RJ_ABORT`` marker, so retry ambiguity always degrades
+        to the proven r10 protocol rather than a racy re-rejoin."""
+        g, newest_fail = 0, None
         for gen, d in self._generations():
             if self._failures(d):
+                newest_fail = (gen, d)
                 g = gen + 1
+        self._rejoining = False
+        self._rejoin_target = None
+        if (self._readmit_enabled() and newest_fail is not None
+                and (self._gen is None or self._gen <= newest_fail[0])):
+            gen, d = newest_fail
+            fails = self._failures(d)
+            if all(self.slice_of(p) == self.si for p in fails):
+                mine = os.path.join(d, self._marker_name("RJRENTER", self.pi))
+                if self.backend.exists(os.path.join(d, _RJ_ABORT)):
+                    pass          # a slice member already aborted rejoin
+                elif self.backend.exists(mine):
+                    # own rejoin residue: this slice already tried to
+                    # rejoin this generation and died mid-handshake —
+                    # publish the abort so survivors stop holding, then
+                    # take the whole-pod path
+                    self._rejoin_abort(d, "rejoin retry in generation "
+                                          f"{gen} — falling back")
+                else:
+                    g = gen
+                    self._rejoining = True
         if self._gen is not None:
-            if g > self._gen and self._goodput is not None:
+            if (not self._rejoining and g > self._gen
+                    and self._goodput is not None):
                 self._goodput.count("restart_generations", g - self._gen)
-            g = max(g, self._gen)
+            g = max(g, self._gen) if not self._rejoining else g
         changed = g != self._gen
         self._gen = g
         self._gen_dir = self._gen_path(g)
-        os.makedirs(self._gen_dir, exist_ok=True)
-        try:
-            # an attempting host is by definition not done: clear our own
-            # completion marker (a previous run's residue when the same
-            # checkpoint_dir is relaunched; peers also time-scope what
-            # they honor — _exited_peers)
-            os.remove(os.path.join(self.directory, f"EXIT_{self.pi:05d}"))
-        except OSError:
-            pass
+        self.backend.ensure_dir(self._gen_dir)
+        # an attempting host is by definition not done: clear our own
+        # completion marker (a previous run's residue when the same
+        # checkpoint_dir is relaunched; peers also time-scope what
+        # they honor — _exited_peers)
+        self.backend.delete(
+            os.path.join(self.directory, self._marker_name("EXIT", self.pi)))
         self._attempt_wall_t = time.time()
         self._last_polled = -1
         self._escalated = False
         self._progress_t = time.monotonic()
         self._write_heartbeat()
-        if changed:
-            self._log(f"[pod] host {self.pi}/{self.pc} entering "
-                      f"generation {g}")
+        if changed or self._rejoining:
+            self._log(f"[pod] host {self.pi}/{self.pc} "
+                      + (f"REJOINING generation {g} (slice {self.si} "
+                         f"re-admission)" if self._rejoining
+                         else f"entering generation {g}"))
         self._ensure_thread()
         self._prune_generations()
         return g
@@ -300,8 +432,9 @@ class PodCoordinator:
         never rejoin the pod, and learning that immediately beats
         waiting out gather_timeout_s per attempt."""
         try:
-            _write_json_atomic(
-                os.path.join(self.directory, f"EXIT_{self.pi:05d}"),
+            self.backend.put_json(
+                os.path.join(self.directory,
+                             self._marker_name("EXIT", self.pi)),
                 {"step": self._step if step is None else int(step),
                  "unix_time": round(time.time(), 3)})
         except OSError as e:
@@ -325,18 +458,29 @@ class PodCoordinator:
         for pi in range(self.pc):
             if pi == self.pi:
                 continue
-            got = _read_json(os.path.join(self.directory, f"EXIT_{pi:05d}"))
+            got = self.backend.read_json(
+                os.path.join(self.directory, self._marker_name("EXIT", pi)))
             if got is not None and got.get("unix_time", 0.0) > self._created_t:
                 out.append(pi)
         return out
 
     def _write_fail(self, kind: str, reason: str,
                     step: Optional[int] = None) -> None:
-        _write_json_atomic(
-            self._marker("FAIL", self.pi),
-            {"kind": kind, "reason": reason[:500],
-             "step": self._step if step is None else int(step),
-             "unix_time": round(time.time(), 3)})
+        self._write_fail_for(self.pi, kind, reason, step=step)
+
+    def _write_fail_for(self, pi: int, kind: str, reason: str,
+                        step: Optional[int] = None) -> None:
+        """FAIL marker under a given identity.  Besides our own
+        failures, a SURVIVOR writes a proxied marker on behalf of a
+        heartbeat-stale peer slice (SIGKILL/machine loss wrote nothing)
+        so the relaunched slice finds a durable incident record to key
+        its re-admission on."""
+        payload = {"kind": kind, "reason": reason[:500],
+                   "step": self._step if step is None else int(step),
+                   "unix_time": round(time.time(), 3)}
+        if pi != self.pi:
+            payload["proxied_by"] = self.pi
+        self.backend.put_json(self._marker("FAIL", pi), payload)
 
     def check(self, step: int) -> None:
         """Main-thread poll, called once per dispatch; raises
@@ -344,14 +488,30 @@ class PodCoordinator:
         must be abandoned.  Cadence-gated with the same boundary-
         crossing algebra as the preemption agreement bit (sync_every;
         robust to K-step dispatch boundaries), EXCEPT after a local
-        watchdog escalation, which must surface on the very next poll."""
+        watchdog escalation, which must surface on the very next poll.
+
+        Multi-slice (r14): a rejoining slice drives its re-admission
+        handshake here instead of failure polling (the incident's own-
+        slice FAIL markers are residue, not news), and a survivor that
+        released from its hold below the agreed target finishes the
+        release once it has caught up to it."""
         self._step = int(step)
         self._progress_t = time.monotonic()
+        if self._rejoining:
+            self.rejoin_sync(step)
+            return
+        if self._release_target is not None:
+            if step >= self._release_target:
+                self._finish_release(self._release_target)
+            return
         prev, self._last_polled = self._last_polled, step
         if not self._escalated and prev >= 0 \
                 and step // self.sync_every <= prev // self.sync_every:
             return
         self._raise_observed_failures()
+
+    def _readmit_enabled(self) -> bool:
+        return self.readmit_timeout_s > 0 and self.sc > 1
 
     def _raise_observed_failures(self) -> None:
         gen_dir = self._require_gen()
@@ -363,6 +523,17 @@ class PodCoordinator:
             newest = max((f.get("unix_time", now) for f in fails.values()),
                          default=now)
             detect = max(now - newest, 0.0)
+            failed_slices = {self.slice_of(p) for p in fails}
+            if (self._readmit_enabled() and own is None
+                    and self.si not in failed_slices
+                    and len(failed_slices) == 1):
+                # the incident is confined to ONE foreign slice: park in
+                # a bounded hold and let the platform restart + re-admit
+                # that slice, instead of burning a whole-pod restart
+                if self._goodput is not None:
+                    self._goodput.add("detect_s", detect)
+                self._await_readmission(set(fails), failed_slices.pop())
+                return
             if self._goodput is not None:
                 self._goodput.count("peer_failures")
                 self._goodput.add("detect_s", detect)
@@ -382,6 +553,26 @@ class PodCoordinator:
         stale = self._stale_peers(now)
         if stale:
             pi0, age = stale[0]
+            stale_slices = {self.slice_of(p) for p, _a in stale}
+            if (self._readmit_enabled() and self.si not in stale_slices
+                    and len(stale_slices) == 1):
+                # a silently-dead foreign slice (SIGKILL/machine loss —
+                # nothing was written): publish proxied FAIL markers so
+                # the relaunched slice finds the incident record it
+                # keys its rejoin on, then hold for re-admission
+                if self._goodput is not None:
+                    self._goodput.add("detect_s", age)
+                for p, a in stale:
+                    try:
+                        self._write_fail_for(
+                            p, "stale",
+                            f"heartbeat silent {a:.1f}s > peer_timeout_s="
+                            f"{self.peer_timeout_s:.0f} (proxied)")
+                    except OSError:
+                        pass
+                self._await_readmission({p for p, _a in stale},
+                                        stale_slices.pop())
+                return
             if self._goodput is not None:
                 self._goodput.count("peer_failures")
                 # detect_s = failure-to-observed latency.  The peer died
@@ -413,13 +604,255 @@ class PodCoordinator:
                 # success, not death; stragglers keep running
                 continue
             try:
-                t = os.path.getmtime(self._marker("HB", pi, gen_dir))
+                t = self.backend.mtime(self._marker("HB", pi, gen_dir))
             except OSError:
                 t = self._attempt_wall_t
             age = now - t
             if age > self.peer_timeout_s:
                 out.append((pi, age))
         return out
+
+    # -- slice-granular elastic re-admission (r14) -------------------------
+
+    @property
+    def rejoining(self) -> bool:
+        """True while this host's slice is re-entering the incident's
+        generation: restore + catch-up to the survivors' agreed step,
+        completed by :meth:`rejoin_sync`."""
+        return self._rejoining
+
+    @property
+    def saves_suspended(self) -> bool:
+        """True while this host must not take checkpoint-cadence ticks:
+        a rejoining slice catching up, or a released survivor still
+        below the agreed target.  A save tick taken here could never
+        commit — the rest of the pod is not taking it — and would only
+        burn the commit-barrier timeout into a counted save failure."""
+        return self._rejoining or self._release_target is not None
+
+    def consume_cadence_align(self) -> Optional[int]:
+        """One-shot: the step every host re-anchors its checkpoint
+        cadence to after a completed re-admission (the train loop feeds
+        it to ``AsyncCheckpointManager.align_cadence``).  Hold and
+        catch-up phases suppressed different ticks on different hosts;
+        re-anchoring everyone at the agreed target restores the "pure
+        function of the step sequence" property the pod's two-phase
+        commit barrier depends on."""
+        t, self._align_target = self._align_target, None
+        return t
+
+    def _await_readmission(self, fail_pis: set, failed_si: int) -> None:
+        """Survivor side: the incident is confined to ONE foreign
+        slice, so instead of raising :class:`PeerFailure` (whole-pod
+        restart), park at this dispatch boundary in a bounded hold —
+        publish a ``HOLD`` marker carrying our step (the rejoiner's
+        catch-up target is the max over all survivors' holds), then
+        poll for the restarted slice's ``RJREADY`` barrier.  Falls back
+        to the whole-pod restart on timeout, on a rejoin abort, or on
+        any additional failure outside the incident slice.  The local
+        hang watchdog is paused for the duration (parked is not wedged;
+        heartbeats keep proving liveness to the peers)."""
+        gen_dir = self._require_gen()
+        members = self._slice_members(failed_si)
+        t0 = time.monotonic()
+        deadline = t0 + self.readmit_timeout_s
+        self._log(f"[pod] host {self.pi}: slice {failed_si} failed "
+                  f"(host(s) {sorted(fail_pis)}); holding at step "
+                  f"{self._step} for re-admission "
+                  f"(timeout {self.readmit_timeout_s:.0f}s)")
+        target = None
+        try:
+            with self.pause_watch():
+                # drain this host's in-flight background save BEFORE
+                # publishing HOLD: the rejoiners gate their restore
+                # walk on the COMPLETE hold set, so "every HOLD
+                # present" must imply "every survivor's durable writes
+                # (including process 0's COMMIT) have landed or
+                # terminally failed" — without this, a rejoiner can
+                # walk mid-commit and its slice peers disagree on the
+                # newest checkpoint (RestoreDivergence burns the whole
+                # re-admission).  A drain stuck on a dead slice's DONE
+                # barrier is bounded by the manager's commit timeout;
+                # exceeding the rejoiners' hold window degrades to the
+                # whole-pod fallback, never to divergence.
+                if self.drain_fn is not None:
+                    try:
+                        self.drain_fn()
+                    except Exception:
+                        pass     # a failed save is already counted
+                try:
+                    self.backend.put_json(self._marker("HOLD", self.pi),
+                                          {"step": self._step})
+                except OSError as e:
+                    self._readmit_fallback(
+                        f"could not publish HOLD marker: {e!r}")
+                while True:
+                    if self.backend.exists(os.path.join(gen_dir, _RJ_ABORT)):
+                        self._readmit_fallback(
+                            "the restarting slice aborted its rejoin")
+                    fails = self._failures(gen_dir)
+                    fails.pop(self.pi, None)
+                    extra = sorted(p for p in fails
+                                   if self.slice_of(p) != failed_si)
+                    if extra:
+                        self._readmit_fallback(
+                            f"additional failure on host(s) {extra}")
+                    readys = [self.backend.read_json(
+                        self._marker("RJREADY", p, gen_dir))
+                        for p in members]
+                    if readys and all(r is not None for r in readys):
+                        target = max(int(r["step"]) for r in readys)
+                        break
+                    if time.monotonic() > deadline:
+                        self._readmit_fallback(
+                            f"re-admission timed out after "
+                            f"{self.readmit_timeout_s:.0f}s")
+                    time.sleep(0.05)
+        finally:
+            # parked time is badput either way (released or fallen
+            # back) — the slice-MTTR hold component
+            if self._goodput is not None:
+                self._goodput.add("readmission_hold_s",
+                                  time.monotonic() - t0)
+        if self._step >= target:
+            self._finish_release(target)
+        else:
+            # parked below the pod's agreed target (we observed the
+            # failure earlier than a faster peer): resume stepping with
+            # saves suspended and finish the release at the target
+            self._release_target = int(target)
+            self._log(f"[pod] host {self.pi}: released from hold at step "
+                      f"{self._step}; catching up to the agreed step "
+                      f"{target}")
+
+    def _readmit_fallback(self, why: str) -> None:
+        if self._goodput is not None:
+            self._goodput.count("pod_fallback_restarts")
+            self._goodput.count("peer_failures")
+        raise PeerFailure(
+            f"slice re-admission failed in generation {self._gen} ({why}) "
+            f"— falling back to a whole-pod restart")
+
+    def rejoin_sync(self, step: int) -> None:
+        """Rejoining-slice side of re-admission, driven from the
+        attempt path (right after restore — the target may already be
+        reached) and from :meth:`check` during catch-up.  First call
+        agrees the catch-up target (max over the survivors' HOLD
+        steps — provably >= the restored checkpoint step, since a
+        commit at step S implies every host passed S); once this
+        host's step reaches it, the slice joins its ``RJREADY``
+        readiness barrier and every pod host releases: the generation
+        advances IN PLACE (fresh marker namespace, no restart) and
+        training resumes from the agreed step."""
+        if not self._rejoining:
+            return
+        self._step = int(step)
+        if self._rejoin_target is None:
+            self._rejoin_target = self._agree_rejoin_target()
+        target = self._rejoin_target
+        if step < target:
+            return
+        gen_dir = self._require_gen()
+        members = self._slice_members(self.si)
+        self.backend.put_json(self._marker("RJREADY", self.pi),
+                              {"step": int(target)})
+        deadline = time.monotonic() + self.readmit_timeout_s
+        with self.pause_watch():
+            while True:
+                readys = [self.backend.read_json(
+                    self._marker("RJREADY", p, gen_dir)) for p in members]
+                if all(r is not None for r in readys):
+                    break
+                foreign = sorted(
+                    p for p in self._failures(gen_dir)
+                    if self.slice_of(p) != self.si)
+                if foreign:
+                    self._rejoin_fallback(
+                        gen_dir, f"host(s) {foreign} failed during "
+                                 f"re-admission")
+                if time.monotonic() > deadline:
+                    self._rejoin_fallback(
+                        gen_dir, "slice readiness barrier timed out")
+                time.sleep(0.05)
+        self._rejoining = False
+        self._rejoin_target = None
+        self._finish_release(target)
+
+    def _agree_rejoin_target(self) -> int:
+        """The catch-up step: max over every survivor's HOLD marker
+        (bounded wait for the complete set — survivors publish within
+        one poll cadence of the incident)."""
+        gen_dir = self._require_gen()
+        survivors = [p for p in range(self.pc)
+                     if self.slice_of(p) != self.si]
+        deadline = time.monotonic() + self.readmit_timeout_s
+        with self.pause_watch():
+            while True:
+                holds = [self.backend.read_json(
+                    self._marker("HOLD", p, gen_dir)) for p in survivors]
+                if holds and all(h is not None for h in holds):
+                    return max(int(h["step"]) for h in holds)
+                foreign = sorted(
+                    p for p in self._failures(gen_dir)
+                    if self.slice_of(p) != self.si)
+                if foreign:
+                    self._rejoin_fallback(
+                        gen_dir, f"surviving host(s) {foreign} failed "
+                                 f"while agreeing the catch-up target")
+                if time.monotonic() > deadline:
+                    self._rejoin_fallback(
+                        gen_dir, "survivors never published their HOLD "
+                                 "markers")
+                time.sleep(0.05)
+
+    def _rejoin_fallback(self, gen_dir: str, why: str) -> None:
+        """Rejoiner-side fallback: durably abort (so parked survivors
+        release into the whole-pod path immediately instead of waiting
+        out their hold) and raise the restartable failure."""
+        self._rejoining = False
+        self._rejoin_target = None
+        self._rejoin_abort(gen_dir, why)
+        if self._goodput is not None:
+            self._goodput.count("pod_fallback_restarts")
+        raise PeerFailure(
+            f"slice {self.si} re-admission failed in generation "
+            f"{self._gen} ({why}) — falling back to a whole-pod restart")
+
+    def _rejoin_abort(self, gen_dir: str, why: str) -> None:
+        import json
+        try:
+            self.backend.create_if_absent(
+                os.path.join(gen_dir, _RJ_ABORT),
+                json.dumps({"pi": self.pi, "why": why[:300],
+                            "unix_time": round(time.time(), 3)}
+                           ).encode("utf-8"))
+        except OSError:
+            pass     # survivors still fall back via their hold timeout
+
+    def _finish_release(self, target: int) -> None:
+        """Completion of a re-admission, symmetric on every host:
+        advance to the next generation IN PLACE (fresh marker
+        namespace — the incident's FAIL/HOLD/RJREADY residue stays
+        behind in the old one, which any later whole-pod restart
+        computes past anyway), refresh the liveness clocks, and expose
+        the cadence re-align target for the train loop."""
+        self._release_target = None
+        self._align_target = int(target)
+        if self._goodput is not None:
+            self._goodput.count("slice_readmissions")
+        g = (self._gen or 0) + 1
+        self._gen = g
+        self._gen_dir = self._gen_path(g)
+        self.backend.ensure_dir(self._gen_dir)
+        # peers complete their release at their own pace: age their
+        # missing heartbeats in the new generation from NOW, not from
+        # the attempt start, or a slow releaser would look stale
+        self._attempt_wall_t = time.time()
+        self._last_polled = -1
+        self._progress_t = time.monotonic()
+        self._write_heartbeat()
+        self._log(f"[pod] host {self.pi}: slice re-admission complete at "
+                  f"step {target}; advancing to generation {g} in place")
 
     # -- restore step agreement (fs-simulated pods) ------------------------
 
@@ -448,30 +881,61 @@ class PodCoordinator:
         post-walk step agreement), and each phase needs its own marker
         file.  One restore per generation (the supervisor wiring
         guarantees it — each attempt enters a fresh generation after
-        any failure)."""
+        any failure).
+
+        Slice re-admission (r14): while rejoining, the barrier spans
+        only THIS slice's hosts (the survivors are parked in their
+        hold, not restoring) under ``RJ``-prefixed marker names — the
+        original attempt's whole-pod RESTORE markers in the same
+        generation are not re-read; the incident slice's own FAIL
+        residue is expected and ignored, and any failure path aborts
+        the rejoin durably so the survivors fall back fast."""
         gen_dir = self._require_gen()
         kind = "RESTORE" if phase == "agree" else f"R{phase.upper()}"
-        _write_json_atomic(self._marker(kind, self.pi),
-                           {"step": int(step)})
+        members = list(range(self.pc))
+        if self._rejoining:
+            kind = "RJ" + kind
+            members = self._slice_members(self.si)
+            if phase == "enter" and self._rejoin_target is None:
+                # BEFORE the restore walk: wait for the COMPLETE
+                # survivor HOLD set.  Each survivor drains its in-flight
+                # background save before publishing HOLD, so once all
+                # holds exist the committed-checkpoint frontier is
+                # frozen (survivors are parked, process 0's commit
+                # either landed or terminally failed) and every member
+                # of this slice walks the SAME newest checkpoint —
+                # without the gate, a walk racing process 0's
+                # background COMMIT splits the slice on
+                # RestoreDivergence and burns the re-admission.
+                self._rejoin_target = self._agree_rejoin_target()
+        self.backend.put_json(self._marker(kind, self.pi),
+                              {"step": int(step)})
         deadline = time.monotonic() + self.gather_timeout_s
         while True:
             vals = []
-            for pi in range(self.pc):
-                got = _read_json(self._marker(kind, pi, gen_dir))
+            for pi in members:
+                got = self.backend.read_json(self._marker(kind, pi, gen_dir))
                 if got is None:
                     break
                 vals.append(got["step"])
             else:
                 return np.asarray(vals, np.int32)
             fails = {p: f for p, f in self._failures(gen_dir).items()
-                     if p != self.pi}
+                     if p != self.pi
+                     and (not self._rejoining
+                          or self.slice_of(p) != self.si)}
             if fails:
+                if self._rejoining:
+                    self._rejoin_fallback(
+                        gen_dir, f"host(s) {sorted(fails)} failed while "
+                                 f"this slice was restoring")
                 raise PeerFailure(
                     f"host(s) {sorted(fails)} failed while this host was "
                     f"waiting in the restore-agreement barrier "
                     f"(generation {self._gen})")
-            done = [p for p in self._exited_peers()
-                    if _read_json(self._marker(kind, p, gen_dir)) is None]
+            done = [p for p in self._exited_peers() if p in members
+                    and self.backend.read_json(
+                        self._marker(kind, p, gen_dir)) is None]
             if done:
                 # a peer that already COMPLETED the run will never join
                 # this barrier — fail fast (every retry will fail the
@@ -484,11 +948,15 @@ class PodCoordinator:
                     f"without this host; restore the final checkpoint "
                     f"manually or rerun against a fresh directory")
             if time.monotonic() > deadline:
+                if self._rejoining:
+                    self._rejoin_fallback(
+                        gen_dir, f"slice restore barrier timed out after "
+                                 f"{self.gather_timeout_s:.0f}s")
                 raise PeerFailure(
                     f"restore-agreement barrier timed out after "
                     f"{self.gather_timeout_s:.0f}s in generation "
-                    f"{self._gen}: {self.pc - len(vals)} host(s) never "
-                    f"joined")
+                    f"{self._gen}: {len(members) - len(vals)} host(s) "
+                    f"never joined")
             time.sleep(0.05)
 
     # -- health watchdog ---------------------------------------------------
@@ -558,9 +1026,9 @@ class PodCoordinator:
     def _write_heartbeat(self) -> None:
         if self._gen_dir is None:
             return
-        _write_json_atomic(self._marker("HB", self.pi),
-                           {"step": self._step,
-                            "unix_time": round(time.time(), 3)})
+        self.backend.put_json(self._marker("HB", self.pi),
+                              {"step": self._step,
+                               "unix_time": round(time.time(), 3)})
 
     def _escalate_hang(self) -> None:
         """Watchdog-thread escalation: the main thread has made no step
@@ -605,7 +1073,7 @@ class PodCoordinator:
             return
         for gen, d in self._generations():
             if gen <= self._gen - keep:
-                shutil.rmtree(d, ignore_errors=True)
+                self.backend.delete_prefix(d)
 
     def close(self) -> None:
         self._stop.set()

@@ -20,6 +20,12 @@ and fire at exact host-side step/batch counters, never randomly:
   * ``FDT_FAULT_HOST=P``             — scope EVERY armed fault above to
     the host with pod process index P (the other hosts of a simulated
     or real pod run fault-free); unset = every process.
+  * ``FDT_FAULT_SLICE=S``            — scope EVERY armed fault above to
+    the hosts of SLICE S (r14, mirrors FDT_FAULT_HOST at slice
+    granularity: with FDT_SLICE_COUNT set, a die/hang/SIGTERM fault
+    fires on every process of one slice of a simulated multi-slice pod
+    — the arm the elastic re-admission tests kill a whole slice with);
+    composes with FDT_FAULT_HOST (both must match when both are set).
 
 Each fault fires ONCE per process: after a supervisor restart the
 replayed step must succeed, otherwise every injected crash would look
@@ -42,6 +48,7 @@ ENV_SIGTERM = "FDT_FAULT_SIGTERM_AT_STEP"
 ENV_DATA = "FDT_FAULT_DATA_AT_BATCH"
 ENV_HANG = "FDT_FAULT_HANG_AT_STEP"
 ENV_HOST = "FDT_FAULT_HOST"
+ENV_SLICE = "FDT_FAULT_SLICE"
 
 
 class InjectedFault(RuntimeError):
@@ -85,7 +92,8 @@ class FaultPlan:
         """The armed plan, or None when no FDT_FAULT_* is set (the
         common case — callers skip every per-step hook).  With
         ``FDT_FAULT_HOST`` set, only the pod process with that index
-        gets the plan (``process_index`` defaults to
+        gets the plan; with ``FDT_FAULT_SLICE`` set, only the processes
+        of that slice do (``process_index`` defaults to
         :func:`coordinator.pod_identity`, so the env seam and real
         multi-host runs both scope correctly)."""
         die = _env_int(env, ENV_DIE)
@@ -95,12 +103,16 @@ class FaultPlan:
         if die is None and sig is None and data is None and hang is None:
             return None
         host = _env_int(env, ENV_HOST)
-        if host is not None:
+        slice_ = _env_int(env, ENV_SLICE)
+        if host is not None or slice_ is not None:
+            from faster_distributed_training_tpu.resilience.coordinator \
+                import pod_identity, slice_identity
             if process_index is None:
-                from faster_distributed_training_tpu.resilience.coordinator \
-                    import pod_identity
                 process_index = pod_identity(env)[0]
-            if int(process_index) != host:
+            if host is not None and int(process_index) != host:
+                return None
+            if slice_ is not None and slice_identity(
+                    env, process_index=process_index)[0] != slice_:
                 return None
         return cls(die_at=die, sigterm_at=sig, data_at=data, hang_at=hang)
 

@@ -23,14 +23,21 @@ from typing import Callable, Dict, Optional
 # the clock runs is counted productive.  detect_s = failure-to-observed
 # latency (a peer's FAIL marker / heartbeat staleness, the pod
 # coordinator's time-to-detect MTTR component; own-crash restarts cost
-# ~0 detection)
+# ~0 detection).  readmission_hold_s = a survivor's parked time while a
+# failed slice restarts and rejoins (r14 elastic recovery — the hold
+# component of slice MTTR).
 _SEGMENTS = ("checkpoint_blocking_s", "emergency_save_s", "restore_s",
-             "restart_backoff_s", "rollback_lost_s", "detect_s")
+             "restart_backoff_s", "rollback_lost_s", "detect_s",
+             "readmission_hold_s")
 # event counters (peer_failures / step_timeouts / restart_generations:
-# pod-coordinated restarts, resilience/coordinator.py)
+# pod-coordinated restarts, resilience/coordinator.py;
+# slice_readmissions / pod_fallback_restarts: r14 slice-granular
+# recovery — completed re-admissions vs holds/rejoins that degraded to
+# the whole-pod protocol)
 _COUNTERS = ("saves", "skipped_saves", "save_failures", "shard_writes",
              "restores", "restarts", "preemptions", "steps",
-             "peer_failures", "step_timeouts", "restart_generations")
+             "peer_failures", "step_timeouts", "restart_generations",
+             "slice_readmissions", "pod_fallback_restarts")
 
 
 class GoodputTracker:

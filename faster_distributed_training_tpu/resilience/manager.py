@@ -45,7 +45,6 @@ from __future__ import annotations
 
 import os
 import re
-import shutil
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, List, Optional, Tuple
@@ -53,6 +52,7 @@ from typing import Any, Callable, List, Optional, Tuple
 import jax
 import numpy as np
 
+from faster_distributed_training_tpu.resilience import storage as storage_mod
 from faster_distributed_training_tpu.telemetry import spans
 from faster_distributed_training_tpu.train import checkpoint as ckpt
 
@@ -66,13 +66,13 @@ class RestoreDivergence(RuntimeError):
 
 
 def _local_delete_tree(path: str) -> None:
-    """Default retention deleter: local/NFS recursive rmtree.  Retention
-    calls through the manager's ``delete_fn`` hook so an object-store
-    backend can replace this — GCS checkpoint dirs have no rmtree (prune
-    needs batched object deletes under the prefix, and the atomic
-    COMMIT-marker write itself needs a compose-or-rename equivalent);
-    that backend is a ROADMAP item, the hook is its seam."""
-    shutil.rmtree(path, ignore_errors=True)
+    """Historic default retention deleter (local/NFS recursive tree
+    delete), kept for callers that installed it as a ``delete_fn`` hook.
+    Retention now routes through the storage backend's BATCHED
+    ``delete_prefix`` (r14 — the rmtree-per-dir idiom did not map to
+    GCS; list-prefix + batched object deletes is the portable shape),
+    and on POSIX that is exactly this rmtree."""
+    storage_mod.posix_backend().delete_prefix(path)
 
 
 class AsyncCheckpointManager:
@@ -99,7 +99,8 @@ class AsyncCheckpointManager:
                  process_count: Optional[int] = None,
                  shard_owner: Optional[Callable] = None,
                  commit_timeout_s: float = 600.0,
-                 step_gather_fn: Optional[Callable] = None):
+                 step_gather_fn: Optional[Callable] = None,
+                 backend: Optional[storage_mod.StorageBackend] = None):
         self.directory = os.path.abspath(directory)
         self.prefix = prefix
         self.every_steps = int(every_steps)
@@ -108,16 +109,26 @@ class AsyncCheckpointManager:
                     else int(process_count))
         self._pi = (jax.process_index() if process_index is None
                     else int(process_index))
+        # the storage backend every durable write/list/delete routes
+        # through (r14): posix by default — byte-compatible with every
+        # pre-r14 checkpoint dir.  A non-posix backend has no rename
+        # primitive, so the orbax single-file path (which stages +
+        # renames internally) is unusable: force the sharded two-phase
+        # path, whose writes are all whole-object puts.
+        self.backend = backend if backend is not None \
+            else storage_mod.posix_backend()
         # per-host shard-streaming saves whenever >1 process (the r7
-        # sync-collective fallback is gone), or forced for bench/tests
-        self._sharded = bool(force_sharded) or self._pc > 1
+        # sync-collective fallback is gone), or forced for bench/tests,
+        # or whenever the backend is not plain POSIX (see above)
+        self._sharded = (bool(force_sharded) or self._pc > 1
+                         or self.backend.kind != "posix")
         self._shard_owner = shard_owner
         self._commit_timeout_s = float(commit_timeout_s)
         # restore step-agreement transport override: fs-SIMULATED pods
         # (jax single-process per host) pass the pod coordinator's
         # marker-file allgather here; real pods keep the jax collective
         self._step_gather_fn = step_gather_fn
-        self._delete = delete_fn or _local_delete_tree
+        self._delete = delete_fn or self.backend.delete_prefix
         if self.every_secs and self._pc > 1:
             # the wall-clock term reads each host's OWN monotonic clock,
             # so near a threshold hosts disagree: with the sharded path
@@ -141,7 +152,7 @@ class AsyncCheckpointManager:
         self._inflight: Optional[Future] = None
         self._inflight_path: Optional[str] = None
         self._skip_logged = False
-        os.makedirs(self.directory, exist_ok=True)
+        self.backend.ensure_dir(self.directory)
 
     # -- cadence ----------------------------------------------------------
 
@@ -202,6 +213,14 @@ class AsyncCheckpointManager:
         name = self._name(step)
         if not (self.async_save or sync):
             sync = True      # async disabled: blocking collective path
+        if sync and self.backend.kind != "posix":
+            # the sync path is the single-file orbax save, which stages
+            # + renames internally — impossible on an object store.  A
+            # sharded save followed by a full drain gives the same
+            # blocking "committed on return" contract on the backend.
+            ok = self._save_sharded(state, step, meta, name, segment)
+            self._drain_inflight()
+            return ok
         if sync:
             self._drain_inflight()
             t0 = time.monotonic()
@@ -301,11 +320,13 @@ class AsyncCheckpointManager:
         """Background worker body: phase-1 shard write (every host),
         phase-2 barrier + COMMIT (process 0 only)."""
         with spans.span("ckpt_commit", step=meta.get("step")):
-            ckpt.write_host_shards(path, self._pi, blocks)
+            ckpt.write_host_shards(path, self._pi, blocks,
+                                   backend=self.backend)
             if self._pi == 0:
                 ckpt.commit_sharded_checkpoint(
                     path, meta, n_hosts=self._pc,
-                    timeout_s=self._commit_timeout_s)
+                    timeout_s=self._commit_timeout_s,
+                    backend=self.backend)
 
     def _record_save(self, step: int, blocking_s: float,
                      segment: str = "checkpoint_blocking_s") -> None:
@@ -323,6 +344,15 @@ class AsyncCheckpointManager:
 
     def _name(self, step: int) -> str:
         return f"{self.prefix}_step_{step:09d}"
+
+    def align_cadence(self, step: int) -> None:
+        """Re-anchor the step cadence at `step` (idempotent, forward
+        only).  Called after a completed slice re-admission
+        (coordinator.consume_cadence_align): hold and catch-up phases
+        suppressed different ticks on different hosts, and the pod's
+        commit barrier needs every host's NEXT tick to be the same pure
+        function of the shared step sequence again."""
+        self._last_save_step = max(self._last_save_step or 0, int(step))
 
     def _finalize_inflight(self) -> None:
         """Reap a COMPLETED background save: surface its error (warn +
@@ -371,27 +401,29 @@ class AsyncCheckpointManager:
     # -- discovery / restore ----------------------------------------------
 
     def _entries(self) -> List[Tuple[int, str]]:
-        """[(step, dirname)] of this prefix's step directories, any state."""
+        """[(step, dirname)] of this prefix's step checkpoints, any
+        state — discovered through the backend's one-level entry
+        listing (an object store has no directories: the "entry" is the
+        first key component under the manager's namespace; POSIX reads
+        one directory, never walking the tree)."""
         out = []
-        try:
-            names = os.listdir(self.directory)
-        except OSError:
-            return out
-        for n in names:
-            m = _STEP_DIR.match(n)
+        for name in self.backend.list_entries(self.directory):
+            m = _STEP_DIR.match(name)
             if m and m.group("prefix") == self.prefix:
-                out.append((int(m.group("step")), n))
+                out.append((int(m.group("step")), name))
         return sorted(out)
 
     def committed_steps(self) -> List[int]:
         return [s for s, n in self._entries()
-                if ckpt.is_committed(os.path.join(self.directory, n))]
+                if ckpt.is_committed(os.path.join(self.directory, n),
+                                     backend=self.backend)]
 
     def latest_valid(self) -> Optional[Tuple[int, str]]:
         """Newest COMMITTED (step, name); commit says "fully written",
         restore_latest additionally survives corrupted-but-committed."""
         for step, name in reversed(self._entries()):
-            if ckpt.is_committed(os.path.join(self.directory, name)):
+            if ckpt.is_committed(os.path.join(self.directory, name),
+                                 backend=self.backend):
                 return step, name
         return None
 
@@ -424,16 +456,17 @@ class AsyncCheckpointManager:
         result, restored_step, t0 = None, -1, time.monotonic()
         for step, name in reversed(self._entries()):
             path = os.path.join(self.directory, name)
-            if not ckpt.is_committed(path):
+            if not ckpt.is_committed(path, backend=self.backend):
                 continue
             try:
-                if ckpt.is_sharded_checkpoint(path):
+                if ckpt.is_sharded_checkpoint(path, backend=self.backend):
                     restored, _epoch, _best = ckpt.restore_sharded_checkpoint(
-                        self.directory, name, state)
+                        self.directory, name, state, backend=self.backend)
                 else:
                     restored, _epoch, _best = ckpt.restore_checkpoint(
                         self.directory, name, state)
-                meta = ckpt.read_checkpoint_meta(self.directory, name)
+                meta = ckpt.read_checkpoint_meta(self.directory, name,
+                                                 backend=self.backend)
                 result, restored_step = (restored, meta), step
                 break
             except Exception as e:
@@ -453,7 +486,7 @@ class AsyncCheckpointManager:
         if self._pi == 0:
             for _s, n in self._entries():
                 p = os.path.join(self.directory, n)
-                if not ckpt.is_committed(p):
+                if not ckpt.is_committed(p, backend=self.backend):
                     self._delete(p)
         # cross-host agreement AFTER the walk, joined by EVERY host
         # regardless of its outcome (None restores gather -1): a host
@@ -519,22 +552,23 @@ class AsyncCheckpointManager:
         """Keep the newest `keep` COMMITTED checkpoints; also sweep
         uncommitted residue older than the newest committed one (a
         half-written dir from a crash — never restorable, only disk).
-        Process 0 only; other hosts see the shared-fs result.  Deletion
-        goes through the ``delete_fn`` hook (default: local rmtree) so
-        an object-store retention backend can plug in — see
-        ``_local_delete_tree`` for the GCS gap this seam exists for."""
+        Process 0 only; other hosts see the shared result.  Deletion is
+        the backend's BATCHED ``delete_prefix`` (r14 — rmtree on POSIX,
+        list+batched object deletes on GCS/fake; the ``delete_fn`` hook
+        still overrides for custom retention policies)."""
         if self._pi != 0:
             return
         entries = self._entries()
         committed = [(s, n) for s, n in entries if ckpt.is_committed(
-            os.path.join(self.directory, n))]
+            os.path.join(self.directory, n), backend=self.backend)]
         doomed = [n for _s, n in committed[:-self.keep]]
         if committed:
             newest_committed = committed[-1][0]
             doomed += [n for s, n in entries
                        if s < newest_committed
                        and not ckpt.is_committed(
-                           os.path.join(self.directory, n))
+                           os.path.join(self.directory, n),
+                           backend=self.backend)
                        and os.path.join(self.directory, n)
                        != self._inflight_path]
         for n in doomed:

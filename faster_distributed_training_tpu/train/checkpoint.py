@@ -22,6 +22,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from faster_distributed_training_tpu.resilience import storage as storage_mod
 from faster_distributed_training_tpu.train.state import TrainState
 
 _META = "meta.json"
@@ -38,15 +39,24 @@ _OCP_METADATA = "_CHECKPOINT_METADATA"
 _LEGACY_LAYER_KEY = re.compile(r"^(attn|ffn|ln_attn|ln_ffn)_(\d+)$")
 
 
+def _backend(backend: Optional["storage_mod.StorageBackend"]
+             ) -> "storage_mod.StorageBackend":
+    """Resolve the storage backend every marker/meta/shard write routes
+    through (r14): None -> the POSIX default, byte-compatible with every
+    pre-r14 checkpoint directory.  The orbax ARRAY write of the
+    single-file path is the one seam that stays POSIX-only (orbax owns
+    its own staged-rename atomicity); object-store runs therefore use
+    the sharded two-phase path, which the manager forces for any
+    non-posix backend."""
+    return backend if backend is not None else storage_mod.posix_backend()
+
+
 def _write_json_atomic(path: str, obj: Any) -> None:
-    """tmp + os.replace so a preemption mid-write can never leave a torn
-    file at `path` — the previous content (or absence) survives intact."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(obj, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    """Atomic JSON publish: a preemption mid-write can never leave a
+    torn file at `path` — the previous content (or absence) survives
+    intact.  Delegates to the POSIX storage backend (tmp + replace +
+    fsync, the historic idiom, now owned by resilience/storage.py)."""
+    storage_mod.posix_backend().put_json(path, obj)
 
 
 def migrate_legacy_transformer_params(model_params: Any,
@@ -122,30 +132,33 @@ def save_checkpoint(checkpoint_dir: str, name: str, state: TrainState,
          **(extra_meta or {})})
 
 
-def save_pytree_checkpoint(path: str, tree: Any, meta: dict) -> str:
+def save_pytree_checkpoint(path: str, tree: Any, meta: dict,
+                           backend=None) -> str:
     """Shared save core: orbax arrays (atomic — staged + renamed), then
     meta.json, then the COMMIT marker, both atomically and in that order
     so the marker's presence implies everything before it is complete.
     A preemption at ANY point leaves either the previous checkpoint
-    intact or an uncommitted directory has_checkpoint() rejects."""
+    intact or an uncommitted directory has_checkpoint() rejects.  The
+    orbax array write is inherently POSIX (orbax stages + renames
+    itself); the meta/COMMIT markers route through the backend — on a
+    non-posix backend use the sharded two-phase path instead (the
+    manager does)."""
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
         ckptr.save(path, tree, force=True)
     if jax.process_index() == 0:
-        _write_json_atomic(os.path.join(path, _META), meta)
-        _write_json_atomic(os.path.join(path, _COMMIT),
-                           {"committed_unix_time": round(time.time(), 3)})
+        b = _backend(backend)
+        b.put_json(os.path.join(path, _META), meta)
+        b.put_json(os.path.join(path, _COMMIT),
+                   {"committed_unix_time": round(time.time(), 3)})
     return path
 
 
-def read_checkpoint_meta(checkpoint_dir: str, name: str) -> dict:
+def read_checkpoint_meta(checkpoint_dir: str, name: str,
+                         backend=None) -> dict:
     """The meta.json contents ({} when absent/torn — a torn file is
     impossible post-r7, but pre-r7 checkpoints wrote it non-atomically)."""
     meta_path = os.path.join(_ckpt_dir(checkpoint_dir, name), _META)
-    try:
-        with open(meta_path) as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return {}
+    return _backend(backend).read_json(meta_path) or {}
 
 
 def restore_checkpoint(checkpoint_dir: str, name: str, state: TrainState
@@ -365,12 +378,17 @@ def host_shard_snapshot(state, owner=None) -> list:
     return blocks
 
 
-def write_host_shards(path: str, process_index: int, blocks: list) -> None:
+def write_host_shards(path: str, process_index: int, blocks: list,
+                      backend=None) -> None:
     """Phase 1 of the two-phase sharded save: write this host's blocks
     (flat raw bytes + manifest), then its DONE marker LAST — the marker's
-    presence implies this host's contribution is durably complete."""
+    presence implies this host's contribution is durably complete.
+    Every write routes through the storage backend (r14): atomic
+    whole-object puts, no rename assumed — the same code serves the
+    shared POSIX filesystem and an object store."""
+    b = _backend(backend)
     d = os.path.join(path, _SHARDS)
-    os.makedirs(d, exist_ok=True)
+    b.ensure_dir(d)
     # a DONE marker from a CRASHED earlier attempt at this same step
     # must not be visible while this attempt's blocks are mid-write —
     # process 0's commit barrier would take it as proof this host
@@ -379,8 +397,7 @@ def write_host_shards(path: str, process_index: int, blocks: list) -> None:
     # uncommitted dirs in AsyncCheckpointManager.restore_latest; this
     # covers direct callers of the two-phase primitives too).
     done = os.path.join(d, f"host_{process_index:05d}.DONE")
-    if os.path.exists(done):
-        os.remove(done)
+    b.delete(done)
     arrays, manifest = {}, []
     for i, (key, index, arr) in enumerate(blocks):
         # flat-uint8 VIEW, not a copy (tobytes() would double the
@@ -401,31 +418,30 @@ def write_host_shards(path: str, process_index: int, blocks: list) -> None:
                          "dtype": str(arr.dtype),
                          "shape": shape})
     npz_path = os.path.join(d, f"host_{process_index:05d}.npz")
-    tmp = npz_path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **arrays)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, npz_path)
-    _write_json_atomic(os.path.join(d, f"host_{process_index:05d}.json"),
-                       manifest)
-    _write_json_atomic(done, {"blocks": len(blocks)})
+    b.put_stream(npz_path, lambda f: np.savez(f, **arrays))
+    b.put_json(os.path.join(d, f"host_{process_index:05d}.json"), manifest)
+    b.put_json(done, {"blocks": len(blocks)})
 
 
 def commit_sharded_checkpoint(path: str, meta: dict, n_hosts: int,
                               timeout_s: float = 600.0,
-                              poll_s: float = 0.05) -> None:
+                              poll_s: float = 0.05, backend=None) -> None:
     """Phase 2 (process 0 only): wait until EVERY host's DONE marker is
-    on the shared filesystem — the cross-host completion barrier — then
+    on the shared backend — the cross-host completion barrier — then
     write meta.json and the COMMIT marker, in that order, atomically.
+    The COMMIT itself is a put-if-absent create (GCS
+    ``if_generation_match=0``; O_EXCL on POSIX) — the object-store
+    equivalent of the historic atomic-rename commit, and a lost race
+    means another committer already published the SAME barrier result.
     Raises TimeoutError (leaving the directory uncommitted, hence
     invisible to restore) if a host never finishes within
     ``timeout_s``."""
+    b = _backend(backend)
     d = os.path.join(path, _SHARDS)
     want = [os.path.join(d, f"host_{pi:05d}.DONE") for pi in range(n_hosts)]
     deadline = time.monotonic() + timeout_s
     while True:
-        missing = [w for w in want if not os.path.exists(w)]
+        missing = [w for w in want if not b.exists(w)]
         if not missing:
             break
         if time.monotonic() > deadline:
@@ -434,16 +450,17 @@ def commit_sharded_checkpoint(path: str, meta: dict, n_hosts: int,
                 f"{timeout_s:.0f}s: {len(missing)}/{n_hosts} host DONE "
                 f"markers missing under {path} — leaving it uncommitted")
         time.sleep(poll_s)
-    _write_json_atomic(os.path.join(path, _META), meta)
-    _write_json_atomic(os.path.join(path, _COMMIT),
-                       {"committed_unix_time": round(time.time(), 3),
-                        "sharded_hosts": int(n_hosts)})
+    b.put_json(os.path.join(path, _META), meta)
+    b.create_if_absent(
+        os.path.join(path, _COMMIT),
+        json.dumps({"committed_unix_time": round(time.time(), 3),
+                    "sharded_hosts": int(n_hosts)}).encode("utf-8"))
 
 
-def is_sharded_checkpoint(path: str) -> bool:
+def is_sharded_checkpoint(path: str, backend=None) -> bool:
     """True when `path` is a per-host shard-streaming checkpoint (vs a
     single-file orbax one) — restore dispatches on this."""
-    return os.path.isdir(os.path.join(path, _SHARDS))
+    return _backend(backend).any_prefix(os.path.join(path, _SHARDS))
 
 
 def _normalized_regions(index, shape) -> Tuple[Tuple[Tuple[int, int], ...]]:
@@ -490,7 +507,8 @@ def template_needed_regions(template_leaf) -> Optional[list]:
 
 def restore_sharded_checkpoint(checkpoint_dir: str, name: str,
                                state: TrainState,
-                               needed_fn=None, stats: Optional[dict] = None
+                               needed_fn=None, stats: Optional[dict] = None,
+                               backend=None
                                ) -> Tuple[TrainState, int, float]:
     """Reassemble the state from the per-host shard files and fit it
     onto the (freshly created) `state` template — the sharded analog of
@@ -511,8 +529,7 @@ def restore_sharded_checkpoint(checkpoint_dir: str, name: str,
     blocks_skipped.  A leaf whose read blocks do not cover every needed
     region exactly raises — the resilience manager's newest-VALID walk
     then falls back past it."""
-    import glob as _glob
-
+    b = _backend(backend)
     path = _ckpt_dir(checkpoint_dir, name)
     d = os.path.join(path, _SHARDS)
     template = _state_pytree(state)
@@ -528,10 +545,16 @@ def restore_sharded_checkpoint(checkpoint_dir: str, name: str,
     # coverage is an exact sum of block intersections.
     out = {}
     st = {"bytes_read": 0, "blocks_read": 0, "blocks_skipped": 0}
-    for jf in sorted(_glob.glob(os.path.join(d, "host_*.json"))):
-        with open(jf) as f:
-            manifest = json.load(f)
-        npz = np.load(jf[:-len(".json")] + ".npz")
+    manifests = sorted(
+        k for k in b.list_prefix(os.path.join(d, "host_"))
+        if k.endswith(".json") and os.path.basename(k).startswith("host_"))
+    for jf in manifests:
+        manifest = b.read_json(jf)
+        if manifest is None:
+            raise ValueError(f"unreadable shard manifest {jf}")
+        # backend.open_read keeps np.load's lazy per-member zip access
+        # (ranged reads on object stores), so skipped blocks stay unread
+        npz = np.load(b.open_read(jf[:-len(".json")] + ".npz"))
         for entry in manifest:
             key = entry["leaf"]
             if key not in key_to_leaf:
@@ -588,7 +611,7 @@ def restore_sharded_checkpoint(checkpoint_dir: str, name: str,
     if stats is not None:
         stats.update(st)
     restored = jax.tree_util.tree_unflatten(treedef, leaves)
-    meta = read_checkpoint_meta(checkpoint_dir, name)
+    meta = read_checkpoint_meta(checkpoint_dir, name, backend=b)
     new_state = state.replace(
         step=restored["step"], params=restored["params"],
         batch_stats=restored["batch_stats"],
@@ -609,7 +632,7 @@ def _placed_like(template_leaf, value: np.ndarray):
     return value
 
 
-def is_committed(path: str) -> bool:
+def is_committed(path: str, backend=None) -> bool:
     """True iff `path` holds a COMPLETE checkpoint.
 
     Post-r7 saves: the COMMIT marker (written last — arrays AND meta.json
@@ -619,15 +642,17 @@ def is_committed(path: str) -> bool:
     `_CHECKPOINT_METADATA` with no meta.json, and restoring that torn
     state would default epoch/step to 0 and silently replay the run from
     the start.  A bare directory — a preemption mid-write — is nothing."""
-    if os.path.exists(os.path.join(path, _COMMIT)):
+    b = _backend(backend)
+    if b.exists(os.path.join(path, _COMMIT)):
         return True
-    return (os.path.exists(os.path.join(path, _OCP_METADATA))
-            and os.path.exists(os.path.join(path, _META)))
+    return (b.exists(os.path.join(path, _OCP_METADATA))
+            and b.exists(os.path.join(path, _META)))
 
 
-def has_checkpoint(checkpoint_dir: str, name: str) -> bool:
+def has_checkpoint(checkpoint_dir: str, name: str, backend=None) -> bool:
     """A *restorable* checkpoint exists — not merely a directory.  The
     bare-isdir check it replaces returned True for half-written
     directories, sending --resume into a crash on the next restore."""
     path = _ckpt_dir(checkpoint_dir, name)
-    return os.path.isdir(path) and is_committed(path)
+    return _backend(backend).any_prefix(path) and is_committed(
+        path, backend=backend)

@@ -484,7 +484,18 @@ class Trainer:
             # preemption/save hooks: a dead peer makes the collective
             # emergency save (and the sharded commit barrier) unreachable,
             # so failure observation must preempt anything collective.
+            # Multi-slice (r14): a failure confined to one foreign slice
+            # PARKS here (bounded await_readmission hold) instead of
+            # raising, and a rejoining slice drives its catch-up
+            # handshake here.
             res.coordinator.check(step)
+            # a completed re-admission re-anchors the checkpoint cadence
+            # at the pod's agreed release step, so every host's NEXT
+            # save tick is the same pure function of the step sequence
+            # again (the two-phase commit barrier depends on that)
+            align = res.coordinator.consume_cadence_align()
+            if align is not None and res.manager is not None:
+                res.manager.align_cadence(align)
         # blocking checkpoint work below (emergency save; cadence saves
         # that DRAIN a prior write's commit barrier, up to
         # commit_timeout_s) is legitimate step-thread stalling — suspend
@@ -514,7 +525,14 @@ class Trainer:
                              f"save (set --checkpoint_every to get one)")
                 raise Preempted(f"preempted at step {step}", state=state,
                                 step=step)
-            if res.manager is not None:
+            if res.manager is not None and not (
+                    res.coordinator is not None
+                    and res.coordinator.saves_suspended):
+                # saves_suspended: during a slice's rejoin catch-up (or
+                # a survivor's post-hold catch-up) a cadence tick taken
+                # here could never commit — the rest of the pod is not
+                # taking it — and would only burn the commit-barrier
+                # timeout; the cadence re-aligns at the release step
                 res.manager.maybe_save(state, step, epoch=epoch,
                                        step_in_epoch=step_in_epoch,
                                        best_acc=self.best_acc)

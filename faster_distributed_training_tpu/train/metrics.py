@@ -103,10 +103,17 @@ def format_goodput(tracker) -> str:
         # recovery headline (resilience/coordinator.py, bench
         # restart_mttr_s arm)
         bits.append(f"mttr {s['restart_mttr_s']:.2f}s/restart")
+    if s.get("readmission_hold_s"):
+        # r14 elastic recovery: survivor parked time while a failed
+        # slice restarted and rejoined (the hold component of the
+        # restart_slice_mttr_s bench arm)
+        bits.append(f"readmit hold {s['readmission_hold_s']:.2f}s")
     counts = ", ".join(f"{int(s[k])} {k.rstrip('s') if s[k] == 1 else k}"
                        for k in ("saves", "skipped_saves", "restores",
                                  "restarts", "preemptions", "peer_failures",
-                                 "step_timeouts", "restart_generations")
+                                 "step_timeouts", "restart_generations",
+                                 "slice_readmissions",
+                                 "pod_fallback_restarts")
                        if s.get(k))
     if counts:
         bits.append(counts)

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Storage-routing lint (r14 satellite, tier-1 via
+tests/test_storage.py).
+
+The r14 tentpole moved every durable-write seam in ``resilience/`` and
+``train/checkpoint.py`` onto the pluggable StorageBackend — the POSIX
+rename/rmtree idioms live ONLY in ``resilience/storage.py`` now, so an
+object-store backend (no rename primitive) can serve the same code
+paths.  That property rots silently: one new ``os.replace`` in a marker
+writer re-assumes POSIX and only fails months later on a real GCS run.
+This lint AST-scans the routed modules for direct calls to
+
+    os.replace / os.rename / os.renames / shutil.rmtree
+    (and their from-imported bare names)
+
+and fails on any hit outside storage.py.  Run:
+
+    python scripts/check_storage_routing.py     (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+# modules that must route every durable write through the backend
+SCANNED = (
+    "faster_distributed_training_tpu/resilience",
+    "faster_distributed_training_tpu/train/checkpoint.py",
+)
+# the one module allowed to implement POSIX semantics
+ALLOWED = "faster_distributed_training_tpu/resilience/storage.py"
+
+_BANNED_ATTRS = {("os", "replace"), ("os", "rename"), ("os", "renames"),
+                 ("shutil", "rmtree")}
+_BANNED_NAMES = {"replace": "os", "rename": "os", "renames": "os",
+                 "rmtree": "shutil"}
+
+
+def _banned_calls(path: str) -> list:
+    """[(lineno, description)] of banned primitive calls in one file."""
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    # bare names that were from-imported from a banned module
+    # (``from shutil import rmtree``)
+    imported_bare = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in ("os",
+                                                                "shutil"):
+            for alias in node.names:
+                if alias.name in _BANNED_NAMES \
+                        and _BANNED_NAMES[alias.name] == node.module:
+                    imported_bare[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}")
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            if (fn.value.id, fn.attr) in _BANNED_ATTRS:
+                hits.append((node.lineno, f"{fn.value.id}.{fn.attr}"))
+        elif isinstance(fn, ast.Name) and fn.id in imported_bare:
+            hits.append((node.lineno, imported_bare[fn.id]))
+    return hits
+
+
+def _files() -> list:
+    out = []
+    for rel in SCANNED:
+        p = os.path.join(_REPO, rel)
+        if os.path.isfile(p):
+            out.append(p)
+        else:
+            for dirpath, _dirs, files in os.walk(p):
+                out.extend(os.path.join(dirpath, f) for f in files
+                           if f.endswith(".py"))
+    return sorted(out)
+
+
+def check() -> list:
+    """All violations found, [] when clean."""
+    problems = []
+    allowed = os.path.join(_REPO, ALLOWED)
+    for path in _files():
+        if os.path.abspath(path) == os.path.abspath(allowed):
+            continue
+        for lineno, what in _banned_calls(path):
+            rel = os.path.relpath(path, _REPO)
+            problems.append(
+                f"{rel}:{lineno}: direct {what}() call — durable writes "
+                f"in this module must route through the StorageBackend "
+                f"(resilience/storage.py is the only POSIX-primitive "
+                f"implementation site); a direct rename/rmtree silently "
+                f"re-assumes a shared POSIX filesystem and breaks every "
+                f"object-store backend")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        for p in problems:
+            print(f"[check_storage_routing] {p}")
+        print(f"[check_storage_routing] {len(problems)} problem(s)")
+        return 1
+    print("[check_storage_routing] OK: no direct rename/rmtree outside "
+          "resilience/storage.py")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,33 +1,39 @@
 #!/usr/bin/env python
-"""Pod-restart smoke: a REAL two-process simulated pod (the
+"""Pod-restart smoke: REAL multi-process simulated pods (the
 FDT_POD_INDEX/FDT_POD_COUNT seam — jax single-process per host, restart
 coordination and the sharded two-phase checkpoint commit genuinely
-cross-PROCESS through the shared filesystem), with host 1 killed by an
-injected crash scoped via FDT_FAULT_HOST.  Asserts the r10 acceptance
-at process level:
+cross-PROCESS), with injected kills.  Three scenarios:
 
-  * both supervisors observe the failure (host 1: its own crash;
-    host 0: the FAIL marker) and restart into the SAME generation;
-  * ``restore_latest`` agrees the same checkpoint step on both hosts
-    (the coordinator's marker-file allgather standing in for the jax
-    collective);
-  * both hosts finish every step with final state byte-identical to an
-    uninterrupted single-process reference run (params/opt/RNG digest);
-  * MTTR components land in the goodput summary.
+  * default: the r10 acceptance — a 2-process pod, host 1 killed via
+    FDT_FAULT_HOST, both supervisors converge on the same restart
+    generation, restore the same step, and finish with state digests
+    byte-identical to an uninterrupted single-process reference;
+  * ``--backend fake_object_store`` (r14): the SAME kill/recover
+    scenario with every resilience-critical durable write routed
+    through the rename-free object-store backend (framed generation
+    files under ``<dir>/_objects`` — whole-object PUT + O_EXCL create,
+    no os.replace anywhere): digest equality must hold with no rename
+    primitive, and the script additionally asserts that no marker/step-
+    checkpoint state leaked onto the plain filesystem;
+  * ``--slices 2`` (r14 elastic recovery): a 2-slice pod of 4
+    processes (FDT_SLICE_COUNT=2), the whole of slice 1 killed via
+    FDT_FAULT_SLICE — the surviving slice holds at a dispatch boundary
+    (zero restarts, zero restores — it never exits its dispatch loop or
+    rolls back), the killed slice restarts, REJOINS the same
+    generation, catches up to the agreed step, and all four hosts
+    finish digest-equal to the uninterrupted reference with
+    ``slice_readmissions`` counted and ``pod_fallback_restarts`` == 0.
 
-This is the PROCESS-LEVEL twin of
-tests/test_pod_restart.py::TestSimulatedPodEndToEnd (which runs the
-two hosts as threads): nothing survives between attempts except the
-shared checkpoint/coordination directory, exactly as a relaunched pod
-would see it.
-
-    python scripts/pod_restart_smoke.py          # CPU, ~1 min
+    python scripts/pod_restart_smoke.py                      # CPU, ~1 min
+    python scripts/pod_restart_smoke.py --backend fake_object_store
+    python scripts/pod_restart_smoke.py --slices 2
     FDT_SMOKE_DIE_AT=9 python scripts/pod_restart_smoke.py
 
 Prints PASS/FAIL per assertion; exit code 0 iff all pass."""
 
 from __future__ import annotations
 
+import argparse
 import hashlib
 import json
 import os
@@ -49,7 +55,7 @@ CKPT_EVERY = 2     # the cadence's commit barrier also bounds host drift:
 #                    other
 
 
-def reference_cfg(workdir: str):
+def reference_cfg(workdir: str, backend: str = "posix"):
     """The uninterrupted single-process reference configuration — the
     same training math with no pod, no faults, no supervisor."""
     from faster_distributed_training_tpu.config import TrainConfig
@@ -58,7 +64,7 @@ def reference_cfg(workdir: str):
                        d_model=16, d_ff=32, n_heads=2, epochs=EPOCHS,
                        subset_stride=64, optimizer="sgd", precision="fp32",
                        plot=False, workers=0, log_every=0, donate=False,
-                       checkpoint_dir=workdir)
+                       checkpoint_dir=workdir, storage_backend=backend)
 
 
 def state_digest(state) -> str:
@@ -87,7 +93,8 @@ mod = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(mod)
 from faster_distributed_training_tpu.cli import run_training
 
-cfg = mod.reference_cfg(os.environ["FDT_SMOKE_DIR"])
+cfg = mod.reference_cfg(os.environ["FDT_SMOKE_DIR"],
+                        backend=os.environ.get("FDT_SMOKE_BACKEND", "posix"))
 if os.environ.get("FDT_POD_COUNT"):
     cfg = cfg.replace(supervise=True, checkpoint_every=%(every)d,
                       preempt_sync_every=1, peer_timeout_s=5.0,
@@ -101,25 +108,39 @@ print(json.dumps({
     "peer_failures": int(out.get("goodput_peer_failures", 0)),
     "restart_generations": int(out.get("goodput_restart_generations", 0)),
     "restart_mttr_s": float(out.get("goodput_restart_mttr_s", 0.0)),
+    "slice_readmissions": int(out.get("goodput_slice_readmissions", 0)),
+    "pod_fallback_restarts": int(
+        out.get("goodput_pod_fallback_restarts", 0)),
+    "readmission_hold_s": float(
+        out.get("goodput_readmission_hold_s", 0.0)),
 }))
 """
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _spawn(workdir: str, pod: bool, pi: int = 0, die_at: int = 0):
+def _spawn(workdir: str, pod: bool, pi: int = 0, die_at: int = 0,
+           backend: str = "posix", pod_count: int = 2, slices: int = 1,
+           die_slice: int = -1):
     env = dict(os.environ, FDT_SMOKE_DIR=workdir, FDT_SMOKE_REPO=_REPO,
-               JAX_PLATFORMS="cpu")
-    for k in ("FDT_POD_INDEX", "FDT_POD_COUNT", "FDT_FAULT_HOST",
+               FDT_SMOKE_BACKEND=backend, JAX_PLATFORMS="cpu")
+    for k in ("FDT_POD_INDEX", "FDT_POD_COUNT", "FDT_SLICE_COUNT",
+              "FDT_FAULT_HOST", "FDT_FAULT_SLICE",
               "FDT_FAULT_DIE_AT_STEP"):
         env.pop(k, None)
     if pod:
-        env.update(FDT_POD_INDEX=str(pi), FDT_POD_COUNT="2")
+        env.update(FDT_POD_INDEX=str(pi), FDT_POD_COUNT=str(pod_count))
+        if slices > 1:
+            env.update(FDT_SLICE_COUNT=str(slices))
         if die_at:
-            # the crash is armed in BOTH processes' environments; the
-            # FDT_FAULT_HOST scope is what keeps host 0 fault-free
-            env.update(FDT_FAULT_HOST="1",
-                       FDT_FAULT_DIE_AT_STEP=str(die_at))
+            # the crash is armed in EVERY process's environment; the
+            # FDT_FAULT_HOST / FDT_FAULT_SLICE scope is what keeps the
+            # surviving processes fault-free
+            env.update(FDT_FAULT_DIE_AT_STEP=str(die_at))
+            if die_slice >= 0:
+                env.update(FDT_FAULT_SLICE=str(die_slice))
+            else:
+                env.update(FDT_FAULT_HOST="1")
     code = _CHILD % {"every": CKPT_EVERY}
     return subprocess.Popen([sys.executable, "-c", code], env=env,
                             stdout=subprocess.PIPE,
@@ -134,7 +155,17 @@ def _join(proc, label: str) -> dict:
     return json.loads(out.strip().splitlines()[-1])
 
 
-def main(ref_digest: str = "") -> int:
+def _reference_digest() -> str:
+    print(f"phase 0: uninterrupted single-process reference "
+          f"({TOTAL_STEPS} steps)")
+    ref = _join(_spawn(tempfile.mkdtemp(prefix="fdt_pod_ref_"), pod=False),
+                "reference")
+    assert ref["final_step"] == TOTAL_STEPS, ref
+    return ref["digest"]
+
+
+def main(ref_digest: str = "", backend: str = "posix",
+         slices: int = 1) -> int:
     die_at = int(os.environ.get("FDT_SMOKE_DIE_AT", "6"))
     failures = 0
 
@@ -145,18 +176,18 @@ def main(ref_digest: str = "") -> int:
         failures += 0 if ok else 1
 
     if not ref_digest:
-        print(f"phase 0: uninterrupted single-process reference "
-              f"({TOTAL_STEPS} steps)")
-        ref = _join(_spawn(tempfile.mkdtemp(prefix="fdt_pod_ref_"),
-                           pod=False), "reference")
-        check("reference ran every step",
-              ref["final_step"] == TOTAL_STEPS, str(ref["final_step"]))
-        ref_digest = ref["digest"]
+        ref_digest = _reference_digest()
+
+    if slices > 1:
+        failures += _run_slice_scenario(check, ref_digest, backend, die_at)
+        print("PASS" if not failures else f"FAIL ({failures} assertion(s))")
+        return 1 if failures else 0
 
     workdir = tempfile.mkdtemp(prefix="fdt_pod_smoke_")
-    print(f"phase 1: 2-process simulated pod, host 1 dies at step "
-          f"{die_at} (shared dir {workdir})")
-    procs = [_spawn(workdir, pod=True, pi=pi, die_at=die_at)
+    print(f"phase 1: 2-process simulated pod ({backend}), host 1 dies at "
+          f"step {die_at} (shared dir {workdir})")
+    procs = [_spawn(workdir, pod=True, pi=pi, die_at=die_at,
+                    backend=backend)
              for pi in (0, 1)]
     h0, h1 = (_join(p, f"host {pi}") for pi, p in enumerate(procs))
 
@@ -172,18 +203,23 @@ def main(ref_digest: str = "") -> int:
           h0["restart_generations"] >= 1
           and h0["restart_generations"] == h1["restart_generations"],
           f"{h0['restart_generations']}/{h1['restart_generations']}")
-    # the generation directory itself records the converged protocol:
+    # the generation namespace itself records the converged protocol:
     # the incident landed in gen 0, both hosts' restore-agreement
-    # markers landed in gen 1
+    # markers landed in gen 1 — read through whichever medium the
+    # markers actually live on
     pod_dir = os.path.join(workdir, "_pod")
-    gens = sorted(n for n in os.listdir(pod_dir) if n.startswith("gen_"))
-    check("shared _pod directory shows the restart generation",
+    be = _inspection_backend(backend, workdir)
+    gens = sorted({k[len(pod_dir) + 1:].split(os.sep)[0].split("/")[0]
+                   for k in be.list_prefix(pod_dir + os.sep)})
+    check("shared _pod namespace shows the restart generation",
           "gen_000001" in gens, str(gens))
     g1 = os.path.join(pod_dir, "gen_000001")
-    agree = sorted(n for n in os.listdir(g1) if n.startswith("RESTORE_"))
+    agree = sorted(os.path.basename(k)
+                   for k in be.list_prefix(g1 + os.sep)
+                   if os.path.basename(k).startswith("RESTORE_"))
     check("both hosts joined the gen-1 restore agreement",
           agree == ["RESTORE_00000", "RESTORE_00001"], str(agree))
-    steps = [json.load(open(os.path.join(g1, a)))["step"] for a in agree]
+    steps = [be.read_json(os.path.join(g1, a))["step"] for a in agree]
     check("restore agreement: both hosts restored the SAME step",
           steps[0] == steps[1] and steps[0] >= 0, str(steps))
     check("host states byte-identical to each other",
@@ -194,10 +230,77 @@ def main(ref_digest: str = "") -> int:
     check("recovery MTTR landed in the goodput summary",
           h0["restart_mttr_s"] > 0 and h1["restart_mttr_s"] > 0,
           f"{h0['restart_mttr_s']}s/{h1['restart_mttr_s']}s")
+    if backend == "fake_object_store":
+        # nothing resilience-critical may have leaked onto the plain
+        # filesystem: markers and step checkpoints live as framed
+        # objects under _objects/ (epoch-level orbax checkpoints are
+        # the documented posix exception)
+        leaked = [n for n in os.listdir(workdir)
+                  if n == "_pod" or "_step_" in n]
+        check("no rename-dependent filesystem state outside the object "
+              "store", not leaked, str(leaked))
+        check("object store holds the pod markers",
+              any("_pod" in k for k in be.list_prefix(workdir + os.sep)))
 
     print("PASS" if not failures else f"FAIL ({failures} assertion(s))")
     return 1 if failures else 0
 
 
+def _inspection_backend(backend: str, workdir: str):
+    # the SAME construction path the children used (build_backend), so
+    # the parent inspects the namespace they actually wrote through
+    from faster_distributed_training_tpu.resilience import storage
+    return storage.build_backend(backend, workdir, log=lambda *_: None)
+
+
+def _run_slice_scenario(check, ref_digest: str, backend: str,
+                        die_at: int) -> int:
+    """2-slice pod, 4 processes, slice 1 killed whole via
+    FDT_FAULT_SLICE: the surviving slice must hold (never restart,
+    never restore), the killed slice must rejoin the SAME generation,
+    and every host must finish digest-equal to the reference."""
+    workdir = tempfile.mkdtemp(prefix="fdt_pod_slice_smoke_")
+    print(f"phase 1: 2-slice pod, 4 processes ({backend}), slice 1 dies "
+          f"at step {die_at} (shared dir {workdir})")
+    procs = [_spawn(workdir, pod=True, pi=pi, die_at=die_at,
+                    backend=backend, pod_count=4, slices=2, die_slice=1)
+             for pi in range(4)]
+    hosts = [_join(p, f"host {pi}") for pi, p in enumerate(procs)]
+    h0, h1, h2, h3 = hosts
+
+    check("all four hosts finished every step",
+          all(h["final_step"] == TOTAL_STEPS for h in hosts),
+          str([h["final_step"] for h in hosts]))
+    check("surviving slice NEVER restarted or rolled back",
+          all(h["restarts"] == 0 and h["restores"] == 0
+              for h in (h0, h1)),
+          f"restarts={[h['restarts'] for h in (h0, h1)]} "
+          f"restores={[h['restores'] for h in (h0, h1)]}")
+    check("surviving slice held for re-admission (hold time billed)",
+          all(h["slice_readmissions"] >= 1
+              and h["readmission_hold_s"] > 0 for h in (h0, h1)),
+          f"readmit={[h['slice_readmissions'] for h in (h0, h1)]} "
+          f"hold={[h['readmission_hold_s'] for h in (h0, h1)]}")
+    check("killed slice restarted and was re-admitted",
+          all(h["restarts"] >= 1 and h["slice_readmissions"] >= 1
+              for h in (h2, h3)),
+          f"restarts={[h['restarts'] for h in (h2, h3)]} "
+          f"readmit={[h['slice_readmissions'] for h in (h2, h3)]}")
+    check("no whole-pod fallback was needed",
+          all(h["pod_fallback_restarts"] == 0 for h in hosts),
+          str([h["pod_fallback_restarts"] for h in hosts]))
+    check("all four digests identical",
+          len({h["digest"] for h in hosts}) == 1)
+    check("...and equal to the uninterrupted reference",
+          h0["digest"] == ref_digest,
+          f"{h0['digest'][:12]} vs {ref_digest[:12]}")
+    return 0
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--backend", default="posix",
+                    choices=["posix", "fake_object_store"])
+    ap.add_argument("--slices", type=int, default=1, choices=[1, 2])
+    args = ap.parse_args()
+    sys.exit(main(backend=args.backend, slices=args.slices))

@@ -1,0 +1,460 @@
+"""Storage-backend tests (r14 tentpole, resilience/storage.py) — all
+CPU, tier-1.
+
+Three layers:
+
+  * backend CONTRACT: atomic put / put-if-absent / ranged read / list /
+    batched delete behave identically on PosixBackend and
+    FakeObjectStoreBackend (memory + file media) — the property that
+    lets one manager/coordinator codebase serve a shared filesystem
+    and an object store;
+  * the FAKE OBJECT STORE specifically: rename-free by construction
+    (``os.replace``/``os.rename`` are trapped and must never fire while
+    it serves a full two-phase checkpoint cycle), generation-
+    preconditioned create, injectable PUT faults, torn-write rejection
+    in the cross-process FileMedium;
+  * the ISSUE acceptance suite on the fake backend: two-phase sharded
+    commit roundtrip, stale-DONE residue sweep, kill-between-phases
+    rejection, commit-barrier timeout -> counted save_failure — the r9
+    guarantees re-proven with no rename primitive anywhere.
+
+Plus the tier-1 storage-routing lint (scripts/check_storage_routing.py):
+no direct rename/rmtree may exist in resilience//train.checkpoint
+outside storage.py."""
+
+import importlib.util
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from faster_distributed_training_tpu.config import TrainConfig
+from faster_distributed_training_tpu.resilience import (
+    AsyncCheckpointManager, GoodputTracker)
+from faster_distributed_training_tpu.resilience import storage
+from faster_distributed_training_tpu.train import checkpoint as ckpt
+
+
+# ---------------------------------------------------------------------------
+# contract suite: one test body, every backend
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(params=["posix", "fake_memory", "fake_file"])
+def backend(request, tmp_path):
+    if request.param == "posix":
+        return storage.PosixBackend()
+    if request.param == "fake_memory":
+        return storage.FakeObjectStoreBackend()
+    return storage.FakeObjectStoreBackend(
+        storage.FileMedium(str(tmp_path / "_objects")),
+        root=str(tmp_path))
+
+
+class TestBackendContract:
+    def test_put_read_roundtrip_and_overwrite(self, backend, tmp_path):
+        k = str(tmp_path / "a" / "obj.json")
+        backend.put_json(k, {"x": 1})
+        assert backend.read_json(k) == {"x": 1}
+        assert backend.exists(k)
+        backend.put_json(k, {"x": 2})           # whole-object overwrite
+        assert backend.read_json(k) == {"x": 2}
+        assert backend.size(k) == len(json.dumps({"x": 2}).encode())
+        assert backend.mtime(k) > 0
+
+    def test_read_absent_is_none_and_exists_false(self, backend, tmp_path):
+        k = str(tmp_path / "nope")
+        assert backend.read_json(k) is None
+        assert not backend.exists(k)
+        with pytest.raises(OSError):
+            backend.read_bytes(k)
+        backend.delete(k)                       # idempotent no-op
+
+    def test_create_if_absent_first_writer_wins(self, backend, tmp_path):
+        k = str(tmp_path / "COMMIT")
+        assert backend.create_if_absent(k, b"first")
+        assert not backend.create_if_absent(k, b"second")
+        assert backend.read_bytes(k) == b"first"
+        backend.delete(k)
+        assert backend.create_if_absent(k, b"third")
+        assert backend.read_bytes(k) == b"third"
+
+    def test_ranged_reads(self, backend, tmp_path):
+        k = str(tmp_path / "blob")
+        backend.put_bytes(k, b"0123456789")
+        assert backend.read_bytes(k, start=3, length=4) == b"3456"
+        assert backend.read_bytes(k, start=8) == b"89"
+        with backend.open_read(k) as f:
+            f.seek(5)
+            assert f.read(2) == b"56"
+            f.seek(-2, os.SEEK_END)
+            assert f.read() == b"89"
+
+    def test_list_and_delete_prefix(self, backend, tmp_path):
+        base = str(tmp_path / "ckpt_step_000000004")
+        for rel in ("shards/host_00000.json", "shards/host_00000.npz",
+                    "meta.json"):
+            backend.put_bytes(os.path.join(base, rel), b"x")
+        backend.put_bytes(str(tmp_path / "other"), b"y")
+        keys = backend.list_prefix(base + os.sep)
+        assert len(keys) == 3 and all(k.startswith(base) for k in keys)
+        assert backend.any_prefix(os.path.join(base, "shards"))
+        assert backend.delete_prefix(base) == 3
+        assert backend.list_prefix(base + os.sep) == []
+        assert backend.exists(str(tmp_path / "other"))
+
+    def test_list_entries_one_level(self, backend, tmp_path):
+        base = str(tmp_path / "dir")
+        backend.put_bytes(os.path.join(base, "gen_000000", "HB_00000"), b"x")
+        backend.put_bytes(os.path.join(base, "gen_000001", "FAIL_00001"),
+                          b"x")
+        backend.put_bytes(os.path.join(base, "EXIT_00000"), b"x")
+        got = backend.list_entries(base)
+        assert set(got) >= {"gen_000000", "gen_000001", "EXIT_00000"}
+        # one path component only — nothing nested leaks through
+        assert all(os.sep not in n and "/" not in n for n in got)
+        assert backend.list_entries(str(tmp_path / "absent")) == []
+
+    def test_npz_lazy_load_through_open_read(self, backend, tmp_path):
+        k = str(tmp_path / "shards.npz")
+        arrays = {"b0": np.arange(7, dtype=np.uint8),
+                  "b1": np.linspace(0, 1, 5).astype(np.float32)}
+        backend.put_stream(k, lambda f: np.savez(f, **arrays))
+        z = np.load(backend.open_read(k))
+        np.testing.assert_array_equal(z["b1"], arrays["b1"])
+        np.testing.assert_array_equal(z["b0"], arrays["b0"])
+
+
+class TestFakeObjectStore:
+    def test_no_rename_operation_exists(self):
+        b = storage.FakeObjectStoreBackend()
+        assert not any("rename" in n or "replace" in n for n in dir(b))
+        assert b.kind == "fake_object_store"
+
+    def test_op_counters(self, tmp_path):
+        b = storage.FakeObjectStoreBackend()
+        b.put_bytes("k", b"v")
+        b.read_bytes("k")
+        b.create_if_absent("c", b"v")
+        b.list_prefix("")
+        b.delete("k")
+        assert b.counts["put"] == 1 and b.counts["read"] == 1
+        assert b.counts["create"] == 1 and b.counts["delete"] == 1
+
+    def test_injected_put_fault(self):
+        b = storage.FakeObjectStoreBackend()
+        b.fail_puts("DONE", count=1)
+        b.put_bytes("fine", b"x")               # non-matching key passes
+        with pytest.raises(OSError):
+            b.put_bytes("shards/host_00000.DONE", b"x")
+        b.put_bytes("shards/host_00000.DONE", b"x")   # armed count spent
+        assert b.exists("shards/host_00000.DONE")
+
+    def test_file_medium_torn_write_invisible(self, tmp_path):
+        med = storage.FileMedium(str(tmp_path / "obj"))
+        med.put("key", b"good payload")
+        # a killed-mid-PUT second generation: framed length promises more
+        # bytes than were written, so the reader must keep serving gen 1
+        enc = med._enc("key")
+        torn = os.path.join(med.root, f"{enc}.g000002")
+        with open(torn, "wb") as f:
+            f.write((100).to_bytes(8, "big") + b"partial")
+        assert med.get("key")[0] == b"good payload"
+        assert "key" in med.list()
+
+    def test_file_medium_generations_supersede_and_sweep(self, tmp_path):
+        med = storage.FileMedium(str(tmp_path / "obj"))
+        for i in range(5):
+            med.put("hb", json.dumps({"i": i}).encode())
+        assert json.loads(med.get("hb")[0])["i"] == 4
+        # superseded generations are swept — a 2s-cadence heartbeat must
+        # not accumulate thousands of files
+        assert len(med._gens("hb")) == 1
+
+    def test_file_medium_cross_instance_visibility(self, tmp_path):
+        a = storage.FileMedium(str(tmp_path / "obj"))
+        b = storage.FileMedium(str(tmp_path / "obj"))
+        a.put("k", b"from-a")
+        assert b.get("k")[0] == b"from-a"       # the cross-process story
+        assert not b.create("k", b"loser")
+        b.remove("k")
+        assert a.get("k") is None
+
+    def test_build_backend_specs(self, tmp_path):
+        assert storage.build_backend("posix", str(tmp_path)).kind == "posix"
+        assert storage.build_backend("", str(tmp_path)).kind == "posix"
+        fb = storage.build_backend("fake_object_store", str(tmp_path),
+                                   log=lambda *_: None)
+        assert fb.kind == "fake_object_store"
+        assert isinstance(fb.medium, storage.FileMedium)
+        with pytest.raises(ValueError):
+            storage.build_backend("s3://nope", str(tmp_path))
+        # GCS: constructs when the client library + credentials are
+        # present, otherwise raises the ACTIONABLE error (missing
+        # client or missing credentials) — never a bare ImportError
+        try:
+            storage.build_backend("gs://bucket/prefix", str(tmp_path),
+                                  log=lambda *_: None)
+        except RuntimeError as e:
+            assert ("google-cloud-storage" in str(e)
+                    or "credential" in str(e).lower())
+
+    def test_gcs_spec_requires_bucket(self, tmp_path):
+        with pytest.raises((ValueError, RuntimeError)):
+            storage.build_backend("gs://", str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# ISSUE acceptance: the full two-phase commit suite on the fake object
+# store, with the rename primitives trapped for the duration
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def no_rename(monkeypatch):
+    """os.replace / os.rename raise for the test body: object-store code
+    paths must never reach them ("zero rename operations issued")."""
+    def _boom(*a, **k):
+        raise AssertionError(f"rename primitive used on an object-store "
+                             f"path: {a}")
+    monkeypatch.setattr(os, "replace", _boom)
+    monkeypatch.setattr(os, "rename", _boom)
+
+
+@pytest.fixture()
+def tiny_state():
+    from faster_distributed_training_tpu.models import Transformer
+    from faster_distributed_training_tpu.optim import build_optimizer
+    from faster_distributed_training_tpu.train import create_train_state
+    cfg = TrainConfig(model="transformer", num_classes=4, batch_size=4,
+                      seq_len=8, optimizer="sgd", precision="fp32",
+                      donate=False)
+    model = Transformer(n_class=4, vocab=32, n_layers=1, h=2,
+                        d_model=16, d_ff=32, d_hidden=16, maxlen=8)
+    tx, _ = build_optimizer(cfg, steps_per_epoch=2)
+    return create_train_state(model, tx, jnp.zeros((4, 8), jnp.int32),
+                              jax.random.PRNGKey(3),
+                              init_kwargs={"train": True})
+
+
+def _assert_tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _pod_managers(d, backend, **kw):
+    """Two simulated pod hosts sharing one object store (the r9 seam on
+    the r14 backend): host 0 owns the replica-0 cover, host 1 owns
+    nothing but its DONE marker is still required by the barrier."""
+    gp = kw.pop("goodput", None)
+    m0 = AsyncCheckpointManager(d, process_index=0, process_count=2,
+                                shard_owner=lambda sh: sh.replica_id == 0,
+                                log=lambda *_: None, commit_timeout_s=20.0,
+                                backend=backend, goodput=gp, **kw)
+    m1 = AsyncCheckpointManager(d, process_index=1, process_count=2,
+                                shard_owner=lambda sh: False,
+                                log=lambda *_: None, commit_timeout_s=20.0,
+                                backend=backend, **kw)
+    return m0, m1
+
+
+class TestTwoPhaseCommitOnObjectStore:
+    def test_roundtrip_bitwise_zero_renames(self, tmp_path, tiny_state,
+                                            no_rename):
+        be = storage.FakeObjectStoreBackend()
+        d = str(tmp_path / "ckpt")
+        m0, m1 = _pod_managers(d, be, every_steps=1)
+        assert m1.save(tiny_state, 4, epoch=1, step_in_epoch=4)
+        m1.wait()
+        path = os.path.join(d, m1._name(4))
+        assert ckpt.is_sharded_checkpoint(path, backend=be)
+        assert not ckpt.is_committed(path, backend=be)   # no COMMIT yet
+        assert m0.save(tiny_state, 4, epoch=1, step_in_epoch=4)
+        m0.wait()
+        assert ckpt.is_committed(path, backend=be)
+        got = m0.restore_latest(tiny_state)
+        assert got is not None
+        restored, meta = got
+        assert meta["step"] == 4 and meta["epoch"] == 1
+        _assert_tree_equal(ckpt._state_pytree(restored),
+                           ckpt._state_pytree(tiny_state))
+        assert be.counts["put"] > 0       # it really ran on the store
+        m0.close(), m1.close()
+
+    def test_kill_between_phases_rejected_and_fallback(self, tmp_path,
+                                                       tiny_state,
+                                                       no_rename):
+        """Phase 1 complete on every host, no COMMIT (process 0 killed
+        before phase 2): has_checkpoint-equivalent rejects it and the
+        restore walk falls back to the older committed save."""
+        be = storage.FakeObjectStoreBackend()
+        d = str(tmp_path / "ckpt")
+        m0, m1 = _pod_managers(d, be, every_steps=1)
+        for m in (m1, m0):        # host 1's DONE first: host 0 commits
+            m.save(tiny_state, 2)
+            m.wait()
+        # newer attempt: both hosts' phase 1 lands, the commit never runs
+        name = m0._name(6)
+        path = os.path.join(d, name)
+        blocks = ckpt.host_shard_snapshot(tiny_state,
+                                          lambda sh: sh.replica_id == 0)
+        ckpt.write_host_shards(path, 0, blocks, backend=be)
+        ckpt.write_host_shards(path, 1, [], backend=be)
+        assert not ckpt.is_committed(path, backend=be)
+        got = m0.restore_latest(tiny_state)
+        assert got is not None and got[1]["step"] == 2   # fell back
+        m0.close(), m1.close()
+
+    def test_stale_done_residue_swept_at_restore(self, tmp_path,
+                                                 tiny_state, no_rename):
+        """The r9 stale-DONE trap on the object store: a full DONE set
+        with no COMMIT is swept by process 0 at restore, so a re-save at
+        the same step can never commit a mix of two attempts' shards."""
+        be = storage.FakeObjectStoreBackend()
+        d = str(tmp_path / "ckpt")
+        m0, m1 = _pod_managers(d, be, every_steps=1)
+        for m in (m1, m0):        # host 1's DONE first: host 0 commits
+            m.save(tiny_state, 2)
+            m.wait()
+        path = os.path.join(d, m0._name(6))
+        blocks = ckpt.host_shard_snapshot(tiny_state,
+                                          lambda sh: sh.replica_id == 0)
+        ckpt.write_host_shards(path, 0, blocks, backend=be)
+        ckpt.write_host_shards(path, 1, [], backend=be)
+        done0 = os.path.join(path, "shards", "host_00000.DONE")
+        assert be.exists(done0)
+        m0.restore_latest(tiny_state)       # process 0: sweeps residue
+        assert not be.exists(done0)
+        assert not be.any_prefix(path)
+        # the re-reached save at step 6 commits clean
+        for m in (m1, m0):
+            m.save(tiny_state, 6)
+            m.wait()
+        assert ckpt.is_committed(path, backend=be)
+        m0.close(), m1.close()
+
+    def test_commit_barrier_timeout_is_counted_save_failure(
+            self, tmp_path, tiny_state, no_rename):
+        """A host that never writes DONE (died mid-phase-1): process 0's
+        commit barrier times out, surfaces as a counted save_failure —
+        not a crash — and the dir stays invisible to restore."""
+        be = storage.FakeObjectStoreBackend()
+        d = str(tmp_path / "ckpt")
+        gp = GoodputTracker()
+        m0 = AsyncCheckpointManager(d, process_index=0, process_count=2,
+                                    shard_owner=lambda sh:
+                                    sh.replica_id == 0,
+                                    log=lambda *_: None,
+                                    commit_timeout_s=0.5, backend=be,
+                                    goodput=gp, every_steps=1)
+        assert m0.save(tiny_state, 4)
+        m0.wait()                     # barrier times out in the worker
+        assert gp.summary()["save_failures"] == 1
+        assert m0.latest_valid() is None
+        m0.close()
+
+    def test_injected_put_fault_is_counted_not_fatal(self, tmp_path,
+                                                     tiny_state,
+                                                     no_rename):
+        """A flaky object store mid-save (PUT failure on the npz): the
+        background writer surfaces it as a counted save_failure and the
+        previous checkpoint stays newest-valid."""
+        be = storage.FakeObjectStoreBackend()
+        d = str(tmp_path / "ckpt")
+        gp = GoodputTracker()
+        m0 = AsyncCheckpointManager(d, process_index=0, process_count=1,
+                                    force_sharded=True, every_steps=1,
+                                    log=lambda *_: None, backend=be,
+                                    goodput=gp)
+        m0.save(tiny_state, 2)
+        m0.wait()
+        be.fail_puts(".npz", count=1)
+        m0.save(tiny_state, 4)
+        m0.wait()
+        assert gp.summary()["save_failures"] == 1
+        assert m0.latest_valid()[0] == 2
+        m0.close()
+
+    def test_single_process_sync_save_on_object_store(self, tmp_path,
+                                                      tiny_state,
+                                                      no_rename):
+        """sync=True on a non-posix backend cannot take the orbax
+        single-file path (it renames internally): it routes through the
+        sharded writer and blocks until committed."""
+        be = storage.FakeObjectStoreBackend()
+        d = str(tmp_path / "ckpt")
+        m = AsyncCheckpointManager(d, process_index=0, process_count=1,
+                                   every_steps=1, log=lambda *_: None,
+                                   backend=be)
+        assert m.save(tiny_state, 3, sync=True)
+        assert m.latest_valid()[0] == 3      # committed on return
+        got = m.restore_latest(tiny_state)
+        _assert_tree_equal(ckpt._state_pytree(got[0]),
+                           ckpt._state_pytree(tiny_state))
+        m.close()
+
+    def test_retention_gc_uses_batched_delete_prefix(self, tmp_path,
+                                                     tiny_state,
+                                                     no_rename):
+        """keep-last-K retention on the object store: pruning is the
+        backend's batched delete_prefix (the `_local_delete_tree`
+        rmtree-per-dir note is closed — no tree primitive involved)."""
+        be = storage.FakeObjectStoreBackend()
+        d = str(tmp_path / "ckpt")
+        m = AsyncCheckpointManager(d, process_index=0, process_count=1,
+                                   every_steps=1, keep=2,
+                                   log=lambda *_: None, backend=be)
+        for s in (2, 4, 6):
+            m.save(tiny_state, s, sync=True)
+        assert [s for s, _n in m._entries()] == [4, 6]
+        assert be.counts["delete"] > 0
+        m.close()
+
+
+def test_posix_backend_byte_compatible_with_legacy_idiom(tmp_path):
+    """PosixBackend.put_json writes exactly what the historic
+    _write_json_atomic wrote: same bytes, a real file at the final path,
+    no staging residue."""
+    p = str(tmp_path / "meta.json")
+    storage.posix_backend().put_json(p, {"step": 4, "epoch": 1})
+    with open(p) as f:
+        assert json.load(f) == {"step": 4, "epoch": 1}
+    assert os.listdir(str(tmp_path)) == ["meta.json"]   # no tmp residue
+
+
+def test_storage_routing_lint_clean():
+    """tier-1 guard: no direct os.replace/os.rename/shutil.rmtree in
+    resilience/ or train/checkpoint.py outside storage.py."""
+    spec = importlib.util.spec_from_file_location(
+        "check_storage_routing",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "check_storage_routing.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check() == []
+
+
+def test_storage_routing_lint_catches_violation(tmp_path):
+    """The lint actually fires: a planted os.replace in a scanned module
+    is reported (the lint's own coverage — rule presence, not vacuity)."""
+    spec = importlib.util.spec_from_file_location(
+        "check_storage_routing2",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "check_storage_routing.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = tmp_path / "resilience_mod.py"
+    bad.write_text("import os\nimport shutil\n"
+                   "from shutil import rmtree\n"
+                   "def f(a, b):\n"
+                   "    os.replace(a, b)\n"
+                   "    shutil.rmtree(a)\n"
+                   "    rmtree(b)\n")
+    hits = mod._banned_calls(str(bad))
+    assert {w for _ln, w in hits} == {"os.replace", "shutil.rmtree"}
