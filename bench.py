@@ -204,15 +204,23 @@ def _resnet_train_program(use_ngd: bool, bs: int, steps: int):
 
 def timed_resnet(use_ngd: bool, bs: int, steps: int):
     """Time `steps` executions of the shared ResNet train program.
-    Returns (elapsed_seconds, compiled_peak_mem_bytes_or_None)."""
+    Returns (elapsed_seconds, compiled_peak_mem_bytes_or_None,
+    state_bytes_table) — the table's ``opt_state_bytes_per_chip`` /
+    ``params_bytes_per_chip`` are the committed HBM-attribution baseline
+    ROADMAP's ZeRO item sizes its win against (today the optimizer state
+    is replicated across any model axis; ZeRO should drop it ~tp×)."""
+    from faster_distributed_training_tpu.telemetry.programs import (
+        state_bytes_table)
+
     mesh, compiled, state, batch, mem = _resnet_train_program(
         use_ngd, bs, steps)
     with mesh:
+        state_bytes = state_bytes_table(state)
         t0 = time.monotonic()
         for _ in range(steps):
             state, metrics = compiled(state, batch)
         _fence(metrics)
-        return time.monotonic() - t0, mem
+        return time.monotonic() - t0, mem, state_bytes
 
 
 def transformer_model_flops(bs: int, seq: int, n_layers: int = 6,
@@ -1042,10 +1050,14 @@ def _prev_bench_record():
 _HIGHER_IS_BETTER = ("value", "tricks_speedup", "ex_per_sec",
                      "img_per_sec", "achieved_tflops", "mfu_pct",
                      "gemm_ceiling")
-_LOWER_IS_BETTER = ("attn_fwdbwd_ms", "peak_mem_bytes", "step_ms")
+_LOWER_IS_BETTER = ("attn_fwdbwd_ms", "peak_mem_bytes", "step_ms",
+                    "bytes_per_chip")
 _REL_THRESHOLD = {"attn_fwdbwd_ms": 0.25,   # ladder: >10% tunnel variance
                   "step_ms": 0.10,          # per-step times: modest noise
-                  "peak_mem_bytes": 0.02}   # compiled memory: deterministic
+                  "peak_mem_bytes": 0.02,   # compiled memory: deterministic
+                  "bytes_per_chip": 0.02}   # state-byte attribution:
+#                                             deterministic (a move means
+#                                             the state tree itself moved)
 _DEFAULT_REL_THRESHOLD = 0.05
 # percentage-POINT metrics get an absolute tolerance instead (a relative
 # threshold on a small ratio amplifies noise: 5.2% -> 6.0% is +15%
@@ -1074,6 +1086,9 @@ PRODUCED_METRIC_PATTERNS = (
     "value", "vs_baseline", "ngd_overhead_pct",
     "resnet_ngd_step_ms", "resnet_sgd_step_ms",
     "compiled_peak_mem_bytes",
+    # r15 HBM attribution (the ZeRO-item baseline): per-chip bytes of
+    # the primary program's train state, params vs optimizer state
+    "params_bytes_per_chip", "opt_state_bytes_per_chip",
     "transformer_agnews_ex_per_sec_*", "transformer_ex_per_sec_*",
     # per-config train arms: EXACT keys, not a transformer_bs*_seq*
     # wildcard — a wildcard here would swallow every future
@@ -1483,7 +1498,7 @@ def main() -> None:
         return
 
     n_chips = max(jax.device_count(), 1)
-    elapsed, mem = timed_resnet(True, bs, steps)
+    elapsed, mem, state_bytes = timed_resnet(True, bs, steps)
     ips_per_chip = bs * steps / elapsed / n_chips
     # vs_baseline: ratio against FDT_BENCH_BASELINE (img/s/chip) when set;
     # 1.0 otherwise = "no external baseline configured" — the absolute value
@@ -1508,6 +1523,16 @@ def main() -> None:
     }
     if mem:
         record["compiled_peak_mem_bytes"] = int(mem)
+    # HBM attribution of the primary program's train state (ISSUE 11
+    # satellite seeding ROADMAP's ZeRO item): opt_state_bytes_per_chip is
+    # the number the optimizer-state sharding win will be measured
+    # against — today's record IS the replicated baseline (the TP overlay
+    # covers params only, so opt state holds full size on every chip of a
+    # model axis).  params_bytes_per_chip beside it gives the ratio.
+    record["params_bytes_per_chip"] = int(
+        state_bytes["params_bytes_per_chip"])
+    record["opt_state_bytes_per_chip"] = int(
+        state_bytes["opt_state_bytes_per_chip"])
     record["bench_unix_time"] = round(time.time(), 1)
 
     if os.environ.get("FDT_BENCH_FAST") != "1":
@@ -2022,6 +2047,7 @@ def _essentials(record: dict) -> dict:
             "transformer_bs64_seq512_mfu_pct",
             "transformer_bs64_seq512_mfu_pct_noise_band_pct",
             "transformer_eval_ex_per_sec_bs256_seq256",
+            "params_bytes_per_chip", "opt_state_bytes_per_chip",
             "tricks_speedup_x", "ckpt_async_overhead_pct",
             "ckpt_async_amortized_overhead_pct",
             "ckpt_async_sharded_overhead_pct", "restart_mttr_s",

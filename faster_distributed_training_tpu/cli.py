@@ -836,15 +836,31 @@ def run_training(cfg: TrainConfig,
     # under --no_telemetry / FDT_TELEMETRY=0 and the hot loop gets zero
     # new work.
     from faster_distributed_training_tpu.telemetry import (
-        build_telemetry, resolve_telemetry_dir, spans, write_manifest)
+        build_telemetry, flight, programs, resolve_telemetry_dir, spans,
+        write_manifest)
     from faster_distributed_training_tpu.utils.profiling import (
         StepWindowProfiler, parse_profile_steps)
 
     ckpt_name = "transformer" if is_text else "resnet"
     telemetry = build_telemetry(cfg, log=log)
     prev_span_recorder = None
+    prev_observatory = None
+    prev_flight = None
     if telemetry is not None:
         prev_span_recorder = spans.set_recorder(telemetry.recorder)
+        # the compile observatory doubles as a process-global (the span
+        # idiom) so seams outside the Trainer — the device-resident
+        # epoch re-shard — observe their compiles through it too
+        prev_observatory = programs.set_observatory(telemetry.observatory)
+        # crash flight recorder: failure seams (supervisor, watchdog,
+        # the unhandled-exception escape below) dump the in-memory ring
+        # + open spans + program table durably — through the r14
+        # storage backend when resilience has one, so a dead slice
+        # leaves forensics where the pod can read them
+        prev_flight = flight.configure(
+            telemetry.directory,
+            backend=res.backend if res is not None else None,
+            goodput=res.goodput if res is not None else None, log=log)
         if telemetry.pi == 0:
             write_manifest(telemetry.directory, cfg, mesh,
                            extra={"steps_per_epoch": steps_per_epoch,
@@ -962,6 +978,15 @@ def run_training(cfg: TrainConfig,
                     state = p.state
                 log(f"[preempt] training stopped cleanly at step {p.step}; "
                     f"re-launch with the same --checkpoint_dir to resume")
+            except BaseException as e:
+                # the run is dying for good (supervisor budget exhausted,
+                # deterministic crash, an unsupervised fault): leave the
+                # flight dump behind before the exception escapes.  The
+                # dump is per-exception-deduplicated, so an incident the
+                # supervisor already dumped doesn't land twice.
+                flight.emergency_dump("unhandled_exception", exc=e,
+                                      step=trainer.global_step)
+                raise
             finally:
                 # even when training dies for good (supervisor budget
                 # exhausted, deterministic crash re-raise): drain the
@@ -973,11 +998,14 @@ def run_training(cfg: TrainConfig,
                 if profiler is not None:
                     profiler.close()   # an open window is still captured
                 if telemetry is not None:
-                    # flush the tail, refresh pod_summary.json, and give
-                    # the process-global span sink back (a crashed run's
+                    # flush the tail, refresh pod_summary.json, merge the
+                    # program table into the manifest, and give the
+                    # process-global sinks back (a crashed run's
                     # telemetry is exactly the telemetry worth keeping)
                     telemetry.close()
                     spans.set_recorder(prev_span_recorder)
+                    programs.set_observatory(prev_observatory)
+                    flight.restore(prev_flight)
 
     if cfg.plot and jax.process_index() == 0 and trainer.history["test_acc"]:
         prefix = ckpt_name

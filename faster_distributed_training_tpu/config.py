@@ -208,6 +208,14 @@ class TrainConfig:
                                       # exceeds this multiple of the pod
                                       # median host-p95 (the [telemetry]
                                       # straggler line)
+    aggregate_grace_s: float = 2.0    # how long process 0 waits at an
+                                      # epoch boundary for the peers'
+                                      # telemetry epoch markers before
+                                      # folding without them (was a
+                                      # hard-coded 2 s — slow CI hosts
+                                      # raced it); skipped hosts are
+                                      # recorded in pod_summary.json
+                                      # (hosts_missing) either way
     telemetry_every: int = 1          # record every Nth dispatch (compile-
                                       # marked firsts always recorded).  The
                                       # r12 note flags per-dispatch
@@ -408,6 +416,14 @@ def build_parser(prog: str = "fdt",
                    help="flag a host whose per-step p95 exceeds this "
                         "multiple of the pod median host-p95 in the "
                         "epoch [telemetry] line")
+    p.add_argument("--aggregate_grace_s", default=d.aggregate_grace_s,
+                   type=float,
+                   help="epoch-boundary grace for the pod telemetry "
+                        "fold: how long process 0 waits for peer epoch "
+                        "markers before aggregating without them "
+                        "(skipped hosts land in pod_summary.json's "
+                        "hosts_missing; raise on slow shared "
+                        "filesystems/CI hosts)")
     p.add_argument("--telemetry_every", default=d.telemetry_every,
                    type=int,
                    help="record every Nth dispatch in the telemetry "
@@ -585,6 +601,7 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         telemetry=not args.no_telemetry,
         telemetry_dir=args.telemetry_dir,
         straggler_ratio=args.straggler_ratio,
+        aggregate_grace_s=args.aggregate_grace_s,
         telemetry_every=args.telemetry_every,
         log_every=args.log_every,
         plot=not args.no_plot,

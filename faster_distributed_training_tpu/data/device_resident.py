@@ -332,9 +332,17 @@ class ShardedDeviceResidentData:
                 return {k: v[idx].reshape((steps, bs) + v.shape[1:])
                         for k, v in data.items()}
 
-            self._reshard = jax.jit(
-                fn, out_shardings={k: self._batch_sharding
-                                   for k in self.arrays})
+            # the per-epoch collective is a real compiled program: route
+            # it through the compile observatory (identity when no
+            # observatory is active) so its compile ms / fingerprint /
+            # memory bytes land beside the train programs'
+            from faster_distributed_training_tpu.telemetry.programs import (
+                wrap_jit)
+            self._reshard = wrap_jit(
+                "epoch_reshard",
+                jax.jit(fn, out_shardings={k: self._batch_sharding
+                                           for k in self.arrays}),
+                sig_argnums=(0, 1))
         with spans.span("epoch_reshard"):
             view = self._reshard(self.arrays, order)
         self._epoch_cache = (epoch, view, order)

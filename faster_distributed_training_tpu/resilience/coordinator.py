@@ -1049,6 +1049,25 @@ class PodCoordinator:
             self._goodput.count("step_timeouts")
         self._log(f"[pod] host {self.pi}: WATCHDOG: {reason}; FAIL marker "
                   f"written, aborting so the pod converges on a restart")
+        # crash flight recorder: the SIGKILL below destroys everything
+        # this process knows — the unflushed telemetry ring, which span
+        # the main thread is wedged inside, the program table.  Dump it
+        # from a side thread with a BOUNDED join: a wedged shared fs
+        # (plausibly the same one that hung the step) must not veto the
+        # abort the peers are waiting on.
+        try:
+            from faster_distributed_training_tpu.telemetry import flight
+            if flight.configured():
+                t = threading.Thread(
+                    target=flight.emergency_dump,
+                    args=("watchdog_abort",),
+                    kwargs={"step": self._step,
+                            "extra": {"watchdog_reason": reason}},
+                    daemon=True)
+                t.start()
+                t.join(timeout=2.0)
+        except Exception:
+            pass
         self._abort(reason)
 
     @staticmethod

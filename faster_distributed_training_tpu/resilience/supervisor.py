@@ -101,6 +101,18 @@ class Supervisor:
                     # publish to the pod BEFORE the backoff so the peers'
                     # next poll observes it while this host sleeps
                     self._coordinator.record_failure(e, step=step)
+                # crash flight recorder (r15 observability): dump the
+                # telemetry ring + open spans + program table durably
+                # BEFORE the restart eats the evidence.  A no-op when
+                # telemetry is off; per-exception deduplicated, so the
+                # final budget-exhausted re-raise escaping to
+                # run_training doesn't dump the same incident twice.
+                # Lazy import: this module stays jax-free and the
+                # failure path is the only caller.
+                from faster_distributed_training_tpu.telemetry import (
+                    flight)
+                flight.emergency_dump("supervisor_failure", exc=e,
+                                      step=step)
                 # PeerFailure never participates in the deterministic-
                 # crash check: its step is the OBSERVATION point (poll-
                 # boundary-quantized, typically the restored step), not

@@ -35,12 +35,18 @@ import os
 import time
 from typing import Callable, Optional
 
+from faster_distributed_training_tpu.telemetry import flight  # noqa: F401
+from faster_distributed_training_tpu.telemetry import programs  # noqa: F401
 from faster_distributed_training_tpu.telemetry import spans  # noqa: F401
 from faster_distributed_training_tpu.telemetry.aggregate import (  # noqa: F401,E501
     RunFold, aggregate_run, pod_epoch_aggregate, publish_epoch_marker,
     read_host_records, span_breakdown, step_time_ms)
+from faster_distributed_training_tpu.telemetry.programs import (  # noqa: F401,E501
+    ObservedJit, ProgramObservatory, sharding_fingerprint, sharding_table,
+    state_bytes_table)
 from faster_distributed_training_tpu.telemetry.recorder import (  # noqa: F401,E501
-    ENV_KILL, MANIFEST, SCHEMA_VERSION, TelemetryRecorder, write_manifest)
+    ENV_KILL, MANIFEST, SCHEMA_VERSION, TELEMETRY_SCHEMA, TelemetryRecorder,
+    update_manifest, write_manifest)
 
 
 def resolve_telemetry_dir(cfg) -> str:
@@ -71,6 +77,13 @@ class RunTelemetry:
         self.aggregate_wait_s = float(aggregate_wait_s)
         self._log = log
         self._closed = False
+        # the compile observatory (telemetry/programs.py): the Trainer
+        # routes its jit compiles through it so every program records
+        # compile ms / HLO fingerprint / cache verdict / memory bytes.
+        # FDT_PROGRAM_OBS=0 removes it (plain jit dispatch, no program
+        # events) while the rest of telemetry stays on.
+        self.observatory = (ProgramObservatory(recorder=recorder, log=log)
+                            if programs.observatory_enabled() else None)
         # incremental per-epoch fold state (process 0 only): each epoch
         # parses only the JSONL tails appended since the last fold
         self._fold = RunFold(self.directory) if self.pi == 0 else None
@@ -95,6 +108,20 @@ class RunTelemetry:
         if self._closed:
             return
         self._closed = True
+        if self.observatory is not None and self.pi == 0:
+            # merge the program table into manifest.json (written at
+            # STARTUP, before anything compiled): per program, compile
+            # ms / fingerprint / cache verdict / memory breakdown — the
+            # run's compile story survives the process.  Before
+            # recorder.close() so a manifest-write crash can't orphan
+            # the stream tail.
+            try:
+                from faster_distributed_training_tpu.telemetry.recorder \
+                    import update_manifest
+                update_manifest(self.directory,
+                                {"compile": self.observatory.summary()})
+            except Exception:
+                pass
         self.recorder.close()
         if self.pi == 0:
             # refresh the committed run-level summary one last time (the
@@ -129,4 +156,10 @@ def build_telemetry(cfg, log: Callable[[str], None] = print
     return RunTelemetry(
         recorder,
         straggler_ratio=float(getattr(cfg, "straggler_ratio", 2.0) or 2.0),
+        # --aggregate_grace_s: how long process 0 waits for the peers'
+        # epoch markers before folding without them (the hard-coded 2 s
+        # raced slow CI hosts; skipped hosts are now also recorded in
+        # pod_summary.json, aggregate.pod_epoch_aggregate)
+        aggregate_wait_s=float(
+            getattr(cfg, "aggregate_grace_s", 2.0) or 0.0),
         log=log)

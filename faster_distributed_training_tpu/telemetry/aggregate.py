@@ -305,6 +305,12 @@ def pod_epoch_aggregate(directory: str, epoch: int, pi: int, pc: int,
                                 upto_epoch=epoch)
     summary["epoch"] = int(epoch)
     summary["hosts_reported"] = sorted(have)
+    # skipped hosts land in pod_summary.json, not just the log line — a
+    # postmortem reading only the committed summary must see that the
+    # fold was partial (and what grace it waited); --aggregate_grace_s
+    # sizes the wait for slow CI hosts
+    summary["hosts_missing"] = sorted(want - have)
+    summary["grace_s"] = round(max(wait_s, 0.0), 3)
     pod = summary.get("pod")
     if pod:
         log(f"[telemetry] epoch {epoch}: pod step "

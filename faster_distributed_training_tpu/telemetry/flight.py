@@ -1,0 +1,193 @@
+"""Crash flight recorder: a dead process leaves forensics behind.
+
+The r12 JSONL stream tells a run's story — but only the part the
+background writer flushed before the process died, and a SIGKILLed or
+crashed host's most interesting seconds are exactly the unflushed tail.
+This module durably dumps, at the moment of failure, everything the
+process knows about itself:
+
+  * the recorder's in-memory RING of recent records (recorder.py keeps
+    the last ``recent`` records — flushed or not — in a bounded deque
+    precisely for this dump);
+  * the spans currently OPEN (a host that dies inside ``restore`` or
+    ``ckpt_commit`` names the phase it died in, with elapsed ms);
+  * the goodput/MTTR snapshot, the compile-observatory program table
+    (telemetry/programs.py), the triggering exception with traceback,
+    and the drop counter.
+
+The dump rides the r14 :class:`StorageBackend` when the resilience
+bundle has one (``telemetry/flight_<pi>_<ts>.json`` — on a pod the
+shared medium is exactly where the survivors/postmortem can read it;
+posix otherwise).  Callers are the failure seams ISSUE 11 names:
+``Supervisor.run``'s except branch and ``PodCoordinator.record_failure``
+(every restartable failure), the watchdog's hard-abort path (dumped
+from a side thread with a bounded join so a wedged filesystem cannot
+veto the SIGKILL), and ``cli.run_training``'s unhandled-exception
+escape.  Dumps are deduplicated per exception object, so one incident
+traversing several seams lands one file.
+
+Everything here is best-effort by construction: a flight recorder that
+can itself crash the plane is worse than none — every failure path
+logs and returns None.
+
+Render with ``python scripts/telemetry_report.py <dir> --flight``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+from typing import Callable, List, Optional, Tuple
+
+FLIGHT_PREFIX = "flight_"
+
+# process-global dump target, installed by cli.run_training beside the
+# span recorder (configure/restore in its finally): the failure seams
+# (supervisor, coordinator watchdog) reach it without new constructor
+# plumbing, and an unconfigured process (library use, telemetry off)
+# makes every dump a no-op
+_CONFIG: Optional[dict] = None
+# dedupe marker set ON the exception object itself (built-in exceptions
+# are not weakref-able, and a bare-id() registry would let a gc'd
+# exception's reused address silently suppress the dump for a later,
+# unrelated crash — the opposite of best-effort); an attribute dies
+# with the object, so the dedupe is exactly as long-lived as the
+# incident it marks
+_DUMPED_ATTR = "_fdt_flight_dumped"
+
+
+def configure(directory: Optional[str], backend=None, goodput=None,
+              log: Callable[[str], None] = print) -> Optional[dict]:
+    """Install the dump target (None disables).  Returns the previous
+    configuration so callers can restore it."""
+    global _CONFIG
+    prev = _CONFIG
+    _CONFIG = (None if directory is None
+               else {"directory": directory, "backend": backend,
+                     "goodput": goodput, "log": log})
+    return prev
+
+
+def restore(prev: Optional[dict]) -> None:
+    global _CONFIG
+    _CONFIG = prev
+
+
+def configured() -> bool:
+    return _CONFIG is not None
+
+
+def emergency_dump(reason: str, exc: Optional[BaseException] = None,
+                   step: Optional[int] = None,
+                   extra: Optional[dict] = None) -> Optional[str]:
+    """Write the flight dump; returns its path, or None (unconfigured,
+    duplicate exception, or a dump failure — logged, never raised)."""
+    cfg = _CONFIG
+    if cfg is None:
+        return None
+    if exc is not None:
+        # one incident traverses several seams (record_failure, then the
+        # supervisor-exhausted re-raise escaping run_training): dump once
+        if getattr(exc, _DUMPED_ATTR, False):
+            return None
+        try:
+            setattr(exc, _DUMPED_ATTR, True)
+        except (AttributeError, TypeError):
+            pass    # __slots__ exception without a dict: dump every time
+    log = cfg.get("log") or (lambda *_: None)
+    try:
+        payload = build_payload(reason, exc=exc, step=step,
+                                goodput=cfg.get("goodput"), extra=extra)
+        path = os.path.join(
+            cfg["directory"],
+            f"{FLIGHT_PREFIX}{payload['process_index']:05d}_"
+            f"{int(payload['unix_time'] * 1e3)}.json")
+        backend = cfg.get("backend")
+        if backend is not None:
+            backend.put_json(path, payload)
+        else:
+            from faster_distributed_training_tpu.telemetry.recorder import (
+                _write_json_atomic)
+            os.makedirs(cfg["directory"], exist_ok=True)
+            _write_json_atomic(path, payload)
+    except Exception as e:
+        try:
+            log(f"[flight] could not write flight dump ({e!r}) — the "
+                f"JSONL stream (whatever was flushed) is the remaining "
+                f"record")
+        except Exception:
+            pass
+        return None
+    try:
+        log(f"[flight] {reason}: flight dump written to {path}")
+        from faster_distributed_training_tpu.telemetry import spans
+        rec = spans.get_recorder()
+        if rec is not None:
+            rec.record_event("flight", path=path, reason=str(reason))
+            # best-effort flush so the stream itself mentions the dump
+            # (the dump file, already durable, is the real record)
+            rec.flush(wait=False)
+    except Exception:
+        pass
+    return path
+
+
+def build_payload(reason: str, exc: Optional[BaseException] = None,
+                  step: Optional[int] = None, goodput=None,
+                  extra: Optional[dict] = None) -> dict:
+    """The dump itself, assembled from the process-global telemetry
+    state (span recorder, compile observatory).  Pure + side-effect
+    free so tests can assert on it without touching disk."""
+    from faster_distributed_training_tpu.telemetry import programs, spans
+
+    rec = spans.get_recorder()
+    payload: dict = {"schema": 1, "reason": str(reason),
+                     "unix_time": round(time.time(), 3)}
+    if rec is not None:
+        payload["process_index"] = rec.pi
+    else:
+        from faster_distributed_training_tpu.resilience.coordinator import (
+            pod_identity)
+        payload["process_index"] = pod_identity()[0]
+    if step is not None:
+        payload["step"] = int(step)
+    if exc is not None:
+        payload["exception"] = {
+            "type": type(exc).__name__,
+            "message": str(exc)[:2000],
+            "traceback": "".join(traceback.format_exception(
+                type(exc), exc, exc.__traceback__))[-8000:]}
+    payload["active_spans"] = spans.active_spans()
+    if rec is not None:
+        payload["recent_records"] = rec.recent_records()
+        payload["dropped_records"] = rec.dropped_records
+    if goodput is not None:
+        try:
+            payload["goodput"] = goodput.summary()
+        except Exception:
+            pass
+    obs = programs.get_observatory()
+    if obs is not None:
+        payload["programs"] = obs.summary()
+    if extra:
+        payload.update(extra)
+    return payload
+
+
+def read_flights(directory: str) -> List[Tuple[str, dict]]:
+    """[(path, payload)] of every parseable flight dump in ``directory``
+    (posix — object-store dumps are read through the backend that wrote
+    them, e.g. pod_restart_smoke's inspection backend)."""
+    import glob
+
+    out = []
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              FLIGHT_PREFIX + "*.json"))):
+        try:
+            with open(path) as f:
+                out.append((path, json.load(f)))
+        except (OSError, ValueError):
+            continue
+    return out

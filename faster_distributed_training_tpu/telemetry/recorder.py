@@ -47,6 +47,28 @@ by ``"kind"``:
                   story rides the same stream)
   ``flush_stats``  {dropped_records}             (emitted at close when
                   any batch was dropped)
+  ``program``    {name, variant, lowerings, compile_ms, lower_ms,
+                  fingerprint, cache, cache_method, avals,
+                  argument_bytes, output_bytes, temp_bytes,
+                  generated_code_bytes, alias_bytes}
+                 one per observed compile (telemetry/programs.py)
+  ``retrace``    {name, reason, lowerings, avals, prev_avals}
+                 an accidental re-lowering was detected (loud WARNING
+                 beside it)
+  ``memory``     {scope, ...} — scope "state": the per-chip
+                 params/opt_state/batch_stats byte table
+                 (programs.state_bytes_table — opt_state_bytes_per_chip
+                 is ROADMAP's ZeRO-sizing number); scope "epoch":
+                 device memory watermarks; scope "sharding_drift": the
+                 guard fired (expected/got fingerprints + changed
+                 leaves under --debug)
+  ``flight``     {path, reason}                  (a crash flight dump
+                  was written — telemetry/flight.py)
+
+The machine-checkable registry of the above is TELEMETRY_SCHEMA below;
+``scripts/check_telemetry_schema.py`` AST-scans every emission site in
+the package against it (tier-1), so a renamed kind/field fails CI
+instead of silently breaking telemetry_report.py consumers.
 
 Run scoping: the host file is opened in APPEND mode — a supervised
 relaunch of the same run (same checkpoint_dir) continues the same
@@ -64,16 +86,59 @@ device step time; the bench arms remain the fenced ground truth.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 SCHEMA_VERSION = 1
 ENV_KILL = "FDT_TELEMETRY"
 MANIFEST = "manifest.json"
+
+# -- APPEND-ONLY schema registry (scripts/check_telemetry_schema.py) ------
+# kind -> the complete set of fields records of that kind may carry.
+# Fields are ADDED here when an emitter grows one and NEVER removed or
+# renamed (consumers parse by literal name; old entries document
+# history).  A kind mapped to None is OPEN: its fields come from a
+# runtime dict the lint resolves separately ("goodput" =
+# GoodputTracker.summary()'s keys, dynamic per-segment).
+TELEMETRY_SCHEMA: Dict[str, Optional[frozenset]] = {
+    "run_start": frozenset({"t", "process_index", "process_count",
+                            "schema"}),
+    "step": frozenset({"step", "epoch", "n", "k", "wall_ms",
+                       "dispatch_ms", "data_ms", "block_ms", "examples",
+                       "ex_s", "compile"}),
+    "span": frozenset({"name", "dur_ms", "step"}),
+    "epoch": frozenset({"epoch", "steps", "trained_steps", "loss",
+                        "accuracy", "wall_s", "ex_s", "peak_mem_bytes",
+                        "eval_loss", "eval_accuracy"}),
+    "goodput": None,
+    "goodput_event": frozenset({"counter", "total"}),
+    "rollback": frozenset({"epoch", "restored_epoch", "step"}),
+    "flush_stats": frozenset({"dropped_records"}),
+    "program": frozenset({"name", "variant", "lowerings", "compile_ms",
+                          "lower_ms", "fingerprint", "cache",
+                          "cache_method", "avals", "argument_bytes",
+                          "output_bytes", "temp_bytes",
+                          "generated_code_bytes", "alias_bytes"}),
+    "retrace": frozenset({"name", "reason", "lowerings", "avals",
+                          "prev_avals"}),
+    "memory": frozenset({"scope", "epoch", "step",
+                         "params_bytes_per_chip", "params_leaves",
+                         "opt_state_bytes_per_chip", "opt_state_leaves",
+                         "batch_stats_bytes_per_chip",
+                         "batch_stats_leaves", "total_bytes_per_chip",
+                         "top_leaves", "peak_bytes", "bytes_in_use",
+                         "expected", "got", "changed_leaves"}),
+    "flight": frozenset({"path", "reason"}),
+}
+# kinds that once existed but are no longer emitted (none today): the
+# lint's staleness rule consults this instead of forcing removal from
+# the append-only registry above
+RETIRED_KINDS: frozenset = frozenset()
 
 # background-writer backlog bound (batches, not records): beyond this
 # the recorder drops instead of queueing — a wedged shared fs must not
@@ -134,6 +199,30 @@ def write_manifest(directory: str, cfg=None, mesh=None,
     return path
 
 
+def update_manifest(directory: str, extra: dict) -> Optional[str]:
+    """Merge ``extra`` into an existing manifest.json (atomic rewrite) —
+    how the compile observatory's program table lands at run end: the
+    manifest is written once at STARTUP, but per-program compile
+    ms/fingerprint/cache/memory only exist after the programs compiled.
+    Missing/corrupt manifests get a fresh one holding just ``extra``;
+    returns the path, or None when the write fails (best-effort — a
+    full disk at shutdown must not mask the run's real outcome)."""
+    path = os.path.join(directory, MANIFEST)
+    man: dict = {}
+    try:
+        with open(path) as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        pass
+    man.update(extra)
+    try:
+        os.makedirs(directory, exist_ok=True)
+        _write_json_atomic(path, man)
+    except OSError:
+        return None
+    return path
+
+
 class TelemetryRecorder:
     """Host-side ring buffer of telemetry records, flushed as JSONL off
     the critical path (single background writer, append-mode file).
@@ -149,6 +238,7 @@ class TelemetryRecorder:
                  process_count: Optional[int] = None,
                  capacity: int = 256,
                  step_every: int = 1,
+                 recent: int = 256,
                  log: Callable[[str], None] = print):
         if process_index is None or process_count is None:
             # lazy import: resilience.coordinator imports telemetry.spans
@@ -178,6 +268,13 @@ class TelemetryRecorder:
         self._log = log
         self._lock = threading.Lock()
         self._buf: list = []
+        # the flight-recorder RING: the last `recent` records, retained
+        # ACROSS flushes (a crash's most interesting records are the
+        # flushed-or-not tail) — telemetry/flight.py dumps it durably
+        # from the failure seams.  One deque append per record on the
+        # hot path; bounded by construction.
+        self._recent: collections.deque = collections.deque(
+            maxlen=max(int(recent), 1))
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pending = 0
         self.dropped_records = 0
@@ -240,8 +337,15 @@ class TelemetryRecorder:
             if self._closed:
                 return
             self._buf.append(rec)
+            self._recent.append(rec)
             if len(self._buf) >= self.capacity:
                 self._flush_locked()
+
+    def recent_records(self) -> list:
+        """Snapshot of the in-memory ring (newest last) — the crash
+        flight recorder's payload (telemetry/flight.py)."""
+        with self._lock:
+            return list(self._recent)
 
     # -- flushing (background) --------------------------------------------
 

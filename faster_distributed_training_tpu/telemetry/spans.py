@@ -38,10 +38,35 @@ are never renamed; README "Observability" documents them):
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
-from typing import Iterator, Optional
+from typing import Iterator, List, Optional
 
 _ACTIVE = None   # the installed TelemetryRecorder (or None)
+
+# spans currently OPEN, any thread ({token: {name, t0, step, thread}}):
+# the crash flight recorder (telemetry/flight.py) reads this so a host
+# that dies inside restore/ckpt_commit/rendezvous names the phase it
+# died in.  One lock-guarded dict add/remove per span — spans live at
+# checkpoint/epoch boundaries, never per dispatch.
+_OPEN: dict = {}
+_OPEN_LOCK = threading.Lock()
+
+
+def active_spans() -> List[dict]:
+    """[{name, elapsed_ms, step?, thread}] of every span open right now
+    (the flight-dump payload; empty when nothing is in flight)."""
+    now = time.monotonic()
+    with _OPEN_LOCK:
+        out = []
+        for info in _OPEN.values():
+            rec = {"name": info["name"],
+                   "elapsed_ms": round((now - info["t0"]) * 1e3, 3),
+                   "thread": info["thread"]}
+            if info["step"] is not None:
+                rec["step"] = info["step"]
+            out.append(rec)
+        return out
 
 
 def set_recorder(recorder) -> Optional[object]:
@@ -65,10 +90,16 @@ def span(name: str, step: Optional[int] = None) -> Iterator[None]:
     import jax
 
     t0 = time.monotonic()
+    token = object()
+    with _OPEN_LOCK:
+        _OPEN[token] = {"name": name, "t0": t0, "step": step,
+                        "thread": threading.current_thread().name}
     try:
         with jax.profiler.TraceAnnotation(f"fdt/{name}"):
             yield
     finally:
+        with _OPEN_LOCK:
+            _OPEN.pop(token, None)
         rec = _ACTIVE
         if rec is not None:
             rec.record_span(name, (time.monotonic() - t0) * 1e3, step=step)

@@ -31,7 +31,8 @@ from faster_distributed_training_tpu.train.metrics import (MetricAccumulator,
 from faster_distributed_training_tpu.train.state import TrainState
 from faster_distributed_training_tpu.train.steps import (
     make_eval_step, make_fused_train_step, make_train_step)
-from faster_distributed_training_tpu.utils.profiling import peak_memory_bytes
+from faster_distributed_training_tpu.utils.profiling import (
+    memory_watermarks, peak_memory_bytes)
 
 LoaderFn = Callable[[int], Iterable[Dict[str, Any]]]
 
@@ -111,10 +112,30 @@ class Trainer:
         # steps._offload_transfers; evaluate() fetches once per epoch)
         self._offload_shardings = (state_shardings if cfg.host_offload
                                    else None)
-        self.train_step = jax.jit(make_train_step(cfg, state_shardings),
-                                  **donate)
+        # compile observatory (telemetry/programs.py): every program this
+        # Trainer builds goes through an observed explicit lower/compile
+        # on its first call (compile ms, HLO fingerprint, cache verdict,
+        # memory_analysis — all at compile boundaries, nothing
+        # per-dispatch) and dispatches through the AOT executable after.
+        # None (telemetry off / FDT_PROGRAM_OBS=0) keeps plain jit
+        # dispatch, byte-identical to r14.
+        self._observatory = (getattr(telemetry, "observatory", None)
+                             if telemetry is not None else None)
+        self.train_step = self._observe(
+            "train:host:k1",
+            jax.jit(make_train_step(cfg, state_shardings), **donate),
+            sig_argnums=(1,))
         self._fused_cache: Dict[tuple, Callable] = {}
-        self.eval_step = jax.jit(make_eval_step(cfg))
+        # sig_argnums=(1,): eval batches legally vary (text bucket
+        # widths) — each width is a counted VARIANT of the one "eval"
+        # program, not a retrace
+        self.eval_step = self._observe("eval", jax.jit(make_eval_step(cfg)),
+                                       sig_argnums=(1,))
+        # sharding-drift guard state (telemetry/programs.py): the live
+        # state's sharding fingerprint captured after the run's first
+        # dispatch, re-checked at epoch boundaries (_check_sharding_drift)
+        self._sharding_expect: Optional[str] = None
+        self._sharding_detail: Optional[Dict[str, str]] = None
         self.history: Dict[str, List[float]] = {
             "train_acc": [], "test_acc": [], "train_loss": [],
             "test_loss": [], "epoch_time": [], "peak_mem_bytes": []}
@@ -137,6 +158,14 @@ class Trainer:
         # batches run by the most recent run_epoch call (epoch telemetry)
         self._last_epoch_steps = 0
 
+    def _observe(self, name: str, jitted, sig_argnums=()) -> Callable:
+        """Route a jitted program through the compile observatory when
+        one is active (telemetry/programs.py); identity otherwise."""
+        if self._observatory is None:
+            return jitted
+        return self._observatory.wrap(name, jitted,
+                                      sig_argnums=sig_argnums)
+
     def _fused_step(self, kk: int, resident=None) -> Callable:
         """Jitted K-step fused dispatch, cached per (path, kk) — an
         epoch tail shorter than K compiles its own (one-off) program."""
@@ -148,6 +177,12 @@ class Trainer:
                 make_fused_train_step(self.cfg, kk, self._state_shardings,
                                       resident=resident, mesh=mesh),
                 **self._donate)
+            # resident signature args: the per-epoch data/order arrays
+            # and the start scalar (a regression to a python-int start
+            # would surface as a dtype-leak retrace, the r8 bug class)
+            fn = self._observe(f"train:{key[0]}:k{kk}", fn,
+                               sig_argnums=(1,) if resident is None
+                               else (1, 2, 3))
             self._fused_cache[key] = fn
         return fn
 
@@ -202,6 +237,62 @@ class Trainer:
             # actually closing — steady-state dispatches never sync
             prof.after_dispatch(self.global_step,
                                 fence=lambda: float(metrics["loss"]))
+
+    def _observe_state_placement(self, state: TrainState) -> None:
+        """After the run's first dispatch (the epoch loops call this
+        exactly once — a per-dispatch ``is None`` check guards it): emit
+        the per-chip state byte table (kind "memory", scope "state" —
+        ``opt_state_bytes_per_chip`` is ROADMAP's ZeRO-sizing number)
+        and fingerprint the live shardings for the epoch-boundary drift
+        guard.  The fingerprint is of the POST-step state, i.e. what the
+        compiled program's output constraint actually produced — the
+        thing r11 measured drifting."""
+        from faster_distributed_training_tpu.telemetry import programs
+        self._sharding_expect = programs.sharding_fingerprint(state)
+        self._sharding_detail = (programs.sharding_table(state)
+                                 if self.cfg.debug else None)
+        if self.telemetry is not None:
+            self.telemetry.recorder.record_event(
+                "memory", **programs.state_bytes_table(state))
+
+    def _check_sharding_drift(self, state: TrainState, epoch: int) -> None:
+        """Epoch-boundary re-check of the step-1 sharding fingerprint
+        (always-on cheap hash; ``--debug`` keeps the per-leaf table so a
+        drift names the leaves that moved).  The r11 bug class: XLA
+        re-placed donated params between steps until the output pin
+        landed — this guard turns a silent re-placement into a loud
+        WARNING + ``memory``/``sharding_drift`` event."""
+        if self._sharding_expect is None:
+            return
+        from faster_distributed_training_tpu.telemetry import programs
+        got = programs.sharding_fingerprint(state)
+        if got == self._sharding_expect:
+            return
+        changed: list = []
+        if self._sharding_detail is not None:
+            now = programs.sharding_table(state)
+            before = self._sharding_detail
+            changed = sorted(p for p in set(now) | set(before)
+                             if now.get(p) != before.get(p))[:8]
+        import warnings
+        msg = (f"train-state sharding DRIFT at epoch {epoch}: "
+               f"fingerprint {self._sharding_expect} -> {got}"
+               + (f"; changed leaves (first 8): {changed}" if changed
+                  else " (re-run with --debug for the per-leaf diff)")
+               + " — something re-placed the state between donated "
+                 "steps (the r11 params-drift class; check the train "
+                 "step's output sharding pin)")
+        warnings.warn(msg, stacklevel=2)
+        self.log("[memory] WARNING: " + msg)
+        if self.telemetry is not None:
+            self.telemetry.recorder.record_event(
+                "memory", scope="sharding_drift", epoch=epoch,
+                expected=self._sharding_expect, got=got,
+                changed_leaves=changed)
+        # re-anchor on the drifted placement: ONE incident, one warning
+        # (not one per remaining epoch), and the next drift is measured
+        # against what the state actually is now
+        self._observe_state_placement(state)
 
     def run_epoch(self, state: TrainState, loader: Optional[Iterable],
                   epoch: int = 0, start_step: int = 0) -> tuple:
@@ -267,6 +358,8 @@ class Trainer:
                 acc.add(metrics)
                 n += 1
                 self.global_step += 1
+                if self._sharding_expect is None:
+                    self._observe_state_placement(state)
                 self._prof_after(metrics)
                 if res is not None:
                     state = self._resilience_hooks(state, epoch, n)
@@ -379,6 +472,8 @@ class Trainer:
                 acc.add(metrics)
                 n += kk
                 self.global_step += kk
+                if self._sharding_expect is None:
+                    self._observe_state_placement(state)
                 self._prof_after(metrics)
                 if res is not None:
                     state = self._resilience_hooks(state, epoch, n,
@@ -445,6 +540,8 @@ class Trainer:
             acc.add(metrics)
             n += kk
             self.global_step += kk
+            if self._sharding_expect is None:
+                self._observe_state_placement(state)
             self._prof_after(metrics)
             if res is not None:
                 state = self._resilience_hooks(state, epoch, n,
@@ -608,6 +705,12 @@ class Trainer:
         # re-anchor the host step mirror to the device truth (one sync,
         # once per fit — the restored step after a supervisor restart)
         self.global_step = int(jax.device_get(state.step))
+        # a supervisor restart enters fit with a freshly-restored (host)
+        # state whose placement legitimately differs: the drift guard
+        # re-anchors after the next dispatch instead of comparing across
+        # a restore
+        self._sharding_expect = None
+        self._sharding_detail = None
         # supervisor restarts re-enter fit on the SAME Trainer and replay
         # from the restored epoch: drop any history entries the replay
         # will re-append, or plots/returned history would duplicate the
@@ -683,6 +786,10 @@ class Trainer:
                 state = place_on_shardings(state, self._state_shardings)
                 # rollback moved state.step — re-anchor the host mirror
                 self.global_step = int(jax.device_get(state.step))
+                # ...and the sharding-drift baseline: the restored state's
+                # placement is a fresh re-placement, not a drift
+                self._sharding_expect = None
+                self._sharding_detail = None
                 self.log(f"[recover] non-finite loss at epoch {epoch}; "
                          f"restored last-good state from epoch {ck_epoch}, "
                          f"retrying")
@@ -704,6 +811,10 @@ class Trainer:
                 epoch += 1
                 continue
             consecutive_failures = 0
+            # epoch-boundary re-check of the step-1 sharding fingerprint
+            # (the always-on cheap hash; a drift warns loudly + lands a
+            # memory/sharding_drift event)
+            self._check_sharding_drift(state, epoch)
             if cfg.auto_recover:
                 # refresh the rolling last-good snapshot after every finite
                 # epoch, so recovery rolls back one epoch, not to the last
@@ -755,6 +866,16 @@ class Trainer:
                 if peak:
                     ev["peak_mem_bytes"] = int(peak)
                 rec.record_event("epoch", **ev)
+                stats = memory_watermarks()
+                if stats is not None:
+                    # per-epoch device memory watermark as a memory-kind
+                    # event (peak + current bytes in use — backends
+                    # without runtime memory stats, e.g. CPU, skip it;
+                    # the compile-time memory_analysis in the program
+                    # events covers them statically)
+                    rec.record_event("memory", scope="epoch", epoch=epoch,
+                                     peak_bytes=stats["peak_bytes"],
+                                     bytes_in_use=stats["bytes_in_use"])
                 if res is not None:
                     # goodput/MTTR snapshot in the same stream — one
                     # file tells the whole run's story

@@ -143,6 +143,20 @@ def peak_memory_bytes(device: Optional[jax.Device] = None) -> Optional[int]:
     return stats.get("peak_bytes_in_use")
 
 
+def memory_watermarks(device: Optional[jax.Device] = None
+                      ) -> Optional[dict]:
+    """{"peak_bytes", "bytes_in_use"} from the backend's runtime memory
+    stats, or None where they don't exist (CPU, the axon tunnel) — the
+    per-epoch device memory watermark the telemetry ``memory`` events
+    carry (train/loop.py)."""
+    device = device or jax.local_devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if not stats:
+        return None
+    return {"peak_bytes": int(stats.get("peak_bytes_in_use", 0) or 0),
+            "bytes_in_use": int(stats.get("bytes_in_use", 0) or 0)}
+
+
 def compiled_memory_bytes(compiled) -> Optional[int]:
     """Static peak estimate from a compiled executable's memory analysis:
     temp + argument + output − aliased (donated buffers are BOTH an

@@ -24,6 +24,12 @@ cross-PROCESS), with injected kills.  Three scenarios:
     finish digest-equal to the uninterrupted reference with
     ``slice_readmissions`` counted and ``pod_fallback_restarts`` == 0.
 
+The default scenario additionally asserts the r15 crash flight
+recorder: the killed host's injected crash must leave a durable
+``telemetry/flight_<pi>_<ts>.json`` dump (written through the same
+storage backend the children used) that parses and names the fault —
+``scripts/telemetry_report.py --flight`` renders the same files.
+
     python scripts/pod_restart_smoke.py                      # CPU, ~1 min
     python scripts/pod_restart_smoke.py --backend fake_object_store
     python scripts/pod_restart_smoke.py --slices 2
@@ -230,6 +236,25 @@ def main(ref_digest: str = "", backend: str = "posix",
     check("recovery MTTR landed in the goodput summary",
           h0["restart_mttr_s"] > 0 and h1["restart_mttr_s"] > 0,
           f"{h0['restart_mttr_s']}s/{h1['restart_mttr_s']}s")
+    # r15 flight recorder: the killed host's injected crash must have
+    # left a durable flight dump (through whichever storage backend the
+    # children used) that parses and names the fault — the forensics a
+    # real dead slice leaves behind for the pod to read
+    tdir = os.path.join(workdir, "telemetry")
+    dumps = sorted(k for k in be.list_prefix(tdir + os.sep)
+                   if os.path.basename(k).startswith("flight_00001"))
+    check("killed host left a flight dump in the telemetry dir",
+          bool(dumps), str([os.path.basename(d) for d in dumps]))
+    if dumps:
+        fl = be.read_json(dumps[0])
+        exc = (fl or {}).get("exception") or {}
+        check("flight dump parses and names the injected fault",
+              exc.get("type") == "InjectedFault"
+              and str(die_at) in exc.get("message", ""),
+              f"{exc.get('type')}: {exc.get('message', '')[:60]}")
+        check("flight dump carries the in-memory record ring",
+              bool((fl or {}).get("recent_records")),
+              f"{len((fl or {}).get('recent_records', []))} records")
     if backend == "fake_object_store":
         # nothing resilience-critical may have leaked onto the plain
         # filesystem: markers and step checkpoints live as framed

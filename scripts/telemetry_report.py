@@ -14,10 +14,18 @@ Reads the run manifest + every ``host_<pi>.jsonl`` the run emitted
   * the span breakdown (count/total/mean per span name: checkpoint
     snapshot/commit, restore, rendezvous, eval, H2D upload, epoch
     re-shard, first-dispatch compile);
-  * the final goodput/MTTR snapshot riding the same stream.
+  * the final goodput/MTTR snapshot riding the same stream;
+  * (r15) the compile observatory: per-program compile ms, persistent-
+    cache verdict, HLO fingerprint and memory_analysis bytes, plus any
+    RETRACE detections;
+  * (r15) HBM attribution: the per-chip params/opt_state/batch_stats
+    byte table, per-epoch device watermarks, sharding-drift detections;
+  * (r15, ``--flight``) crash flight dumps: the failing host's reason/
+    exception, the spans open at death, the in-memory record ring and
+    the goodput snapshot (telemetry/flight.py).
 
 Run:  python scripts/telemetry_report.py <telemetry_dir>
-          [--straggler_ratio 2.0] [--json]
+          [--straggler_ratio 2.0] [--json] [--flight]
 
 Smoke-tested (tier-1, milliseconds) against the recorded fixture
 ``tests/fixtures/telemetry/`` by tests/test_telemetry.py.
@@ -33,7 +41,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run(directory: str, straggler_ratio: float = 2.0) -> dict:
+def run(directory: str, straggler_ratio: float = 2.0,
+        with_flight: bool = False) -> dict:
     """The report as a dict (main() renders it; tests assert on it)."""
     from faster_distributed_training_tpu.telemetry import (MANIFEST,
                                                            aggregate_run,
@@ -68,6 +77,37 @@ def run(directory: str, straggler_ratio: float = 2.0) -> dict:
     for recs in hosts.values():
         all_recs.extend(recs)
     report["spans"] = span_breakdown(all_recs)
+    # compile observatory (r15): per-program compile ms / fingerprint /
+    # cache verdict / memory bytes from host 0's program events (each
+    # host compiles its own copy; the manifest carries the same table
+    # under "compile" when the run closed cleanly), retraces pooled
+    # across hosts — a retrace anywhere is worth a line
+    progs = [r for r in lead if r.get("kind") == "program"]
+    if progs:
+        report["programs"] = progs
+    retraces = [r for r in all_recs if r.get("kind") == "retrace"]
+    if retraces:
+        report["retraces"] = retraces
+    # HBM attribution: the state byte table (scope "state" — the newest
+    # one; a re-anchor after drift replaces it), per-epoch watermarks,
+    # and any sharding-drift detections
+    mem = [r for r in lead if r.get("kind") == "memory"]
+    states = [r for r in mem if r.get("scope") == "state"]
+    if states:
+        report["state_memory"] = states[-1]
+    marks = [r for r in mem if r.get("scope") == "epoch"]
+    if marks:
+        report["memory_watermarks"] = marks
+    drifts = [r for r in all_recs if r.get("kind") == "memory"
+              and r.get("scope") == "sharding_drift"]
+    if drifts:
+        report["sharding_drifts"] = drifts
+    if with_flight:
+        from faster_distributed_training_tpu.telemetry.flight import (
+            read_flights)
+        report["flights"] = [
+            {"path": p, **payload} for p, payload in
+            read_flights(directory)]
     dropped = sum(r.get("dropped_records", 0) for r in all_recs
                   if r.get("kind") == "flush_stats")
     if dropped:
@@ -137,6 +177,64 @@ def render(report: dict) -> str:
             lines.append(f"  {name:<24} x{st['count']:<4} "
                          f"total={st['total_ms']:>10.1f}ms "
                          f"mean={st['mean_ms']:>8.1f}ms")
+    progs = report.get("programs")
+    if progs:
+        lines.append("compiled programs (host 0; compile ms / cache / "
+                     "HLO fingerprint / temp bytes):")
+        for p in progs:
+            lines.append(
+                f"  {p.get('name', '?'):<24} "
+                f"compile={p.get('compile_ms', 0):>8.1f}ms "
+                f"cache={p.get('cache', '?'):<15} "
+                f"hlo={p.get('fingerprint', '')[:12]:<12} "
+                f"temp={p.get('temp_bytes', 0) / 1e6:>8.1f}MB")
+    for r in report.get("retraces", ()):
+        lines.append(f"RETRACE: program {r.get('name')!r} lowered "
+                     f"{r.get('lowerings')}x ({r.get('reason')}) — "
+                     f"avals [{r.get('avals')}] vs [{r.get('prev_avals')}]")
+    sm = report.get("state_memory")
+    if sm:
+        lines.append(
+            f"train-state HBM per chip: "
+            f"params={sm.get('params_bytes_per_chip', 0) / 1e6:.1f}MB "
+            f"opt_state={sm.get('opt_state_bytes_per_chip', 0) / 1e6:.1f}MB"
+            f" batch_stats="
+            f"{sm.get('batch_stats_bytes_per_chip', 0) / 1e6:.1f}MB "
+            f"(total {sm.get('total_bytes_per_chip', 0) / 1e6:.1f}MB)")
+        for leaf in sm.get("top_leaves", ())[:3]:
+            lines.append(f"  top leaf: {leaf.get('path')} "
+                         f"{leaf.get('bytes_per_chip', 0) / 1e6:.1f}MB")
+    for d in report.get("sharding_drifts", ()):
+        lines.append(f"SHARDING DRIFT at epoch {d.get('epoch')}: "
+                     f"{d.get('expected')} -> {d.get('got')}"
+                     + (f" leaves {d.get('changed_leaves')}"
+                        if d.get("changed_leaves") else ""))
+    flights = report.get("flights")
+    if flights is not None:
+        if not flights:
+            lines.append("flight dumps: none")
+        for fl in flights:
+            exc = fl.get("exception") or {}
+            lines.append(
+                f"FLIGHT {os.path.basename(fl.get('path', '?'))}: "
+                f"{fl.get('reason', '?')}"
+                + (f" at step {fl['step']}" if "step" in fl else "")
+                + (f" — {exc.get('type')}: {exc.get('message')}"
+                   if exc else ""))
+            for s in fl.get("active_spans", ()):
+                lines.append(f"  open span: {s.get('name')} "
+                             f"({s.get('elapsed_ms', 0):.0f}ms, "
+                             f"{s.get('thread')})")
+            ring = fl.get("recent_records", ())
+            steps = [r for r in ring if r.get("kind") == "step"]
+            if steps:
+                lines.append(f"  ring: {len(ring)} records, last step "
+                             f"{steps[-1].get('step')}")
+            g = fl.get("goodput")
+            if g:
+                lines.append(f"  goodput at crash: "
+                             f"{g.get('goodput_pct', '?')}% over "
+                             f"{g.get('wall_s', '?')}s")
     g = report.get("goodput")
     if g:
         lines.append(f"goodput: {g.get('goodput_pct', '?')}% over "
@@ -156,8 +254,13 @@ def main(argv=None) -> dict:
     ap.add_argument("--straggler_ratio", type=float, default=2.0)
     ap.add_argument("--json", action="store_true",
                     help="emit the raw report dict as JSON")
+    ap.add_argument("--flight", action="store_true",
+                    help="include crash flight dumps (telemetry/"
+                         "flight.py): reason, exception, open spans, "
+                         "the in-memory record ring, goodput at crash")
     args = ap.parse_args(argv)
-    report = run(args.directory, straggler_ratio=args.straggler_ratio)
+    report = run(args.directory, straggler_ratio=args.straggler_ratio,
+                 with_flight=args.flight)
     if args.json:
         print(json.dumps(report, indent=1, default=str))
     else:
