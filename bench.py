@@ -785,6 +785,65 @@ def timed_restart_slice_mttr() -> dict:
             "errors": errors, "die_at": die_at}
 
 
+# Serving-latency mixes (r16 serve/ tentpole): one tiny checkpoint,
+# three batch/length request mixes through the REAL serve stack —
+# continuous-batching queue, AOT-warmed per-bucket programs, 2
+# replicas.  "ragged" (full bucket spread, partial batches occur
+# naturally) is the headline mix published as serve_p50_ms /
+# serve_p99_ms / serve_qps_per_chip; the short/long mixes bound the
+# surface (smallest-bucket latency floor vs top-bucket compute).
+SERVE_BENCH_MIXES = (
+    ("short", 4, 8),       # lengths U[4, 8]: smallest bucket only
+    ("ragged", 4, 32),     # lengths U[4, 32]: every bucket + spill
+    ("long", 24, 32),      # lengths U[24, 32]: top bucket only
+)
+
+
+def timed_serve(mix: str) -> dict:
+    """Serving arm (r16): train a tiny transformer checkpoint, stand up
+    the serve/ stack on it (cli.run_serving: AOT-warmed bucket
+    programs, continuous batching, 2 replicas) and push one request
+    mix through the queue.  Reports nearest-rank p50/p99 request
+    latency and sustained qps/chip — the serving tier's headline
+    numbers feeding the regression guard.  The model is tiny by
+    design: the arm measures the queue/batching/dispatch machinery
+    (and the predict program's fixed cost), not the workload."""
+    import shutil
+    import tempfile
+
+    import numpy as _np
+
+    from faster_distributed_training_tpu.cli import (run_serving,
+                                                     run_training)
+    from faster_distributed_training_tpu.config import TrainConfig
+
+    lo, hi = next((l, h) for m, l, h in SERVE_BENCH_MIXES if m == mix)
+    n_req = int(os.environ.get("FDT_BENCH_SERVE_REQUESTS", "128"))
+    d = tempfile.mkdtemp(prefix="fdt_bench_serve_")
+    cfg = TrainConfig(model="transformer", dataset="synthetic",
+                      num_classes=4, batch_size=8, seq_len=32,
+                      seq_buckets=(8, 16, 32), n_layers=1, d_model=16,
+                      d_ff=32, n_heads=2, epochs=1, subset_stride=64,
+                      optimizer="sgd", precision="fp32", plot=False,
+                      workers=0, log_every=0, donate=False,
+                      checkpoint_dir=d, checkpoint_every=8,
+                      serve_batch_size=8, serve_replicas=2,
+                      serve_max_delay_ms=5.0)
+    try:
+        run_training(cfg, log=lambda *_: None)
+        rng = _np.random.default_rng(0)
+        reqs = [rng.integers(1, 1000,
+                             size=int(rng.integers(lo, hi + 1))
+                             ).astype(_np.int32) for _ in range(n_req)]
+        out = run_serving(cfg, requests=reqs, log=lambda *_: None)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return {"mix": mix, "requests": out["requests"],
+            "batches": out["batches"], "padded_rows": out["padded_rows"],
+            "p50_ms": out["p50_ms"], "p99_ms": out["p99_ms"],
+            "qps": out["qps"], "qps_per_chip": out["qps_per_chip"]}
+
+
 def timed_fused(model: str, k: int, bs: int, seq: int, steps: int) -> dict:
     """K-step fused dispatch arm (r8 tentpole): the full train program on
     DEVICE-RESIDENT synthetic data, K steps per dispatch
@@ -1049,11 +1108,17 @@ def _prev_bench_record():
 # NGD-overhead ratio; throughputs are stable to well under 5%).
 _HIGHER_IS_BETTER = ("value", "tricks_speedup", "ex_per_sec",
                      "img_per_sec", "achieved_tflops", "mfu_pct",
-                     "gemm_ceiling")
+                     "gemm_ceiling", "qps_per_chip")
 _LOWER_IS_BETTER = ("attn_fwdbwd_ms", "peak_mem_bytes", "step_ms",
-                    "bytes_per_chip")
+                    "bytes_per_chip", "p50_ms", "p99_ms")
 _REL_THRESHOLD = {"attn_fwdbwd_ms": 0.25,   # ladder: >10% tunnel variance
                   "step_ms": 0.10,          # per-step times: modest noise
+                  "p50_ms": 0.50,           # serve latency percentiles on
+                  "p99_ms": 0.60,           # a shared CPU host: scheduler
+                  #                           sleeps + thread timing noise
+                  #                           dominate; the qps arm is the
+                  #                           tighter serving signal
+                  "qps_per_chip": 0.35,
                   "peak_mem_bytes": 0.02,   # compiled memory: deterministic
                   "bytes_per_chip": 0.02}   # state-byte attribution:
 #                                             deterministic (a move means
@@ -1128,6 +1193,10 @@ PRODUCED_METRIC_PATTERNS = (
     "resnet_bs512_k*_step_ms",
     "data_path_host_step_ms", "data_path_resident_step_ms",
     "resnet_eval_img_per_sec_*", "transformer_eval_ex_per_sec_*",
+    # r16 serving arms (serve/ tentpole): nearest-rank request-latency
+    # percentiles + sustained throughput per mix, ragged = headline
+    "serve_*_p50_ms", "serve_*_p99_ms", "serve_*_qps_per_chip",
+    "serve_p50_ms", "serve_p99_ms", "serve_qps_per_chip",
 )
 # *_step_ms arms measured N-interleaved with a published noise band:
 NOISE_BANDED_STEP_MS = (
@@ -1444,6 +1513,11 @@ def main() -> None:
         # r14 elastic-recovery arm: simulated 2-slice pod, one slice
         # killed and re-admitted; detect + hold + restore decomposition
         print(json.dumps(timed_restart_slice_mttr()))
+        return
+    if child.startswith("serve_"):
+        # r16 serving arm: one batch/length request mix through the
+        # serve/ stack (continuous batching + 2 AOT-warmed replicas)
+        print(json.dumps(timed_serve(child[len("serve_"):])))
         return
     if child.startswith("telem_"):
         # r12 observability arm: per-dispatch recorder on vs off, one
@@ -1790,6 +1864,26 @@ def main() -> None:
                 record["restart_slice_mttr_detect_s"] = smt["detect_s"]
                 record["restart_slice_mttr_hold_s"] = smt["hold_s"]
                 record["restart_slice_mttr_restore_s"] = smt["restore_s"]
+        # Serving arm family (r16 serve/ tentpole): p50/p99 request
+        # latency + sustained qps/chip through the REAL serving stack
+        # (continuous-batching queue, AOT-warmed per-bucket programs, 2
+        # replicas) at three batch/length mixes; the ragged mix is the
+        # headline (serve_p50_ms / serve_p99_ms / serve_qps_per_chip in
+        # essentials).  CPU-container numbers measure the batching/
+        # dispatch machinery — real-TPU latency lands when the driver's
+        # TPU bench does.  Opt out: FDT_BENCH_SERVE=0.
+        if os.environ.get("FDT_BENCH_SERVE", "1") != "0":
+            for mix, _lo, _hi in SERVE_BENCH_MIXES:
+                r = _run_child(f"serve_{mix}")
+                if r and r.get("requests"):
+                    record[f"serve_{mix}_p50_ms"] = r["p50_ms"]
+                    record[f"serve_{mix}_p99_ms"] = r["p99_ms"]
+                    record[f"serve_{mix}_qps_per_chip"] = r["qps_per_chip"]
+            if "serve_ragged_p50_ms" in record:
+                record["serve_p50_ms"] = record["serve_ragged_p50_ms"]
+                record["serve_p99_ms"] = record["serve_ragged_p99_ms"]
+                record["serve_qps_per_chip"] = \
+                    record["serve_ragged_qps_per_chip"]
         # Telemetry-overhead arm (r12 observability tentpole): the
         # per-dispatch recorder must be free — on-vs-off measured N>=5
         # times INTERLEAVED (the r6 noise protocol: alternating children
@@ -1999,7 +2093,8 @@ def main() -> None:
                     and os.environ.get("FDT_BENCH_CKPT", "1") != "0"
                     and os.environ.get("FDT_BENCH_TELEM", "1") != "0"
                     and os.environ.get("FDT_BENCH_QUANT", "1") != "0"
-                    and os.environ.get("FDT_BENCH_KDIS", "1") != "0")
+                    and os.environ.get("FDT_BENCH_KDIS", "1") != "0"
+                    and os.environ.get("FDT_BENCH_SERVE", "1") != "0")
         # r6/r7 standing-note follow-through: the A/B `*_step_ms` pairs
         # are only comparable against a LIVE record — the committed
         # baseline may still be the r5 `record_note` reconstruction,
@@ -2052,6 +2147,7 @@ def _essentials(record: dict) -> dict:
             "ckpt_async_amortized_overhead_pct",
             "ckpt_async_sharded_overhead_pct", "restart_mttr_s",
             "restart_slice_mttr_s",
+            "serve_p50_ms", "serve_p99_ms", "serve_qps_per_chip",
             "telemetry_overhead_pct",
             "transformer_bs256_seq256_quant_off_step_ms",
             "transformer_bs256_seq256_int8_step_ms",

@@ -297,6 +297,37 @@ class TrainConfig:
                                       # failure restarts the whole pod, the
                                       # r10 behavior)
 
+    # -- serving (serve/ package; cli.run_serving) -------------------------
+    serve_replicas: int = 0           # inference replicas: 0 = auto (one
+                                      # per local chip under the
+                                      # replicated-per-chip layout; forced
+                                      # to 1 model-sharded group when the
+                                      # mesh has a model axis — SNIPPETS
+                                      # [3]: 1D is essentially always
+                                      # faster for inference, so shard the
+                                      # model only when it doesn't fit)
+    serve_batch_size: int = 8         # compiled batch dimension every
+                                      # dispatch cell pads to
+    serve_max_delay_ms: float = 20.0  # continuous-batching deadline: how
+                                      # long a partial batch waits for
+                                      # company before flushing with
+                                      # masked pad rows — THE latency/
+                                      # throughput trade-off knob (raise
+                                      # for fuller batches, lower for
+                                      # tail latency)
+    serve_heartbeat_timeout_s: float = 5.0  # a replica silent past this
+                                      # is detached and its work re-
+                                      # dispatched (r10 heartbeat idiom
+                                      # at request scope; must exceed the
+                                      # worst single predict — engines
+                                      # are warmed up so that excludes
+                                      # compiles)
+    serve_readmit_s: float = 0.0      # auto re-admit a detached replica
+                                      # after this many seconds (0 =
+                                      # manual readmit() only)
+    serve_requests: int = 64          # built-in synthetic request count
+                                      # for the CLI serve smoke
+
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
 
@@ -556,6 +587,30 @@ def build_parser(prog: str = "fdt",
                    help="PRNG for the xla dropout impl: threefry = bit-"
                         "reproducible masks (default), rbg = hardware-RNG "
                         "path (faster generation, backend-dependent bits)")
+    p.add_argument("--serve_replicas", default=d.serve_replicas, type=int,
+                   help="inference replicas (serve entrypoint): 0 = auto "
+                        "(one per local chip; one model-sharded group "
+                        "when the mesh has a model axis)")
+    p.add_argument("--serve_batch_size", default=d.serve_batch_size,
+                   type=int,
+                   help="compiled serving batch size every dispatch cell "
+                        "pads to")
+    p.add_argument("--serve_max_delay_ms", default=d.serve_max_delay_ms,
+                   type=float,
+                   help="continuous-batching deadline: max wait before a "
+                        "partial batch flushes with masked pad rows (the "
+                        "latency/throughput trade-off knob)")
+    p.add_argument("--serve_heartbeat_timeout_s",
+                   default=d.serve_heartbeat_timeout_s, type=float,
+                   help="detach a serving replica whose heartbeat is "
+                        "silent past this many seconds; its work "
+                        "re-dispatches to the survivors")
+    p.add_argument("--serve_readmit_s", default=d.serve_readmit_s,
+                   type=float,
+                   help="auto re-admit a detached serving replica after "
+                        "this many seconds (0 = manual only)")
+    p.add_argument("--serve_requests", default=d.serve_requests, type=int,
+                   help="synthetic request count for the CLI serve smoke")
     return p
 
 
@@ -624,6 +679,12 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         mlp_impl=args.mlp_impl, ffn_impl=args.ffn_impl,
         dropout_impl=args.dropout_impl,
         dropout_rng_impl=args.dropout_rng_impl, tricks=args.tricks,
+        serve_replicas=args.serve_replicas,
+        serve_batch_size=args.serve_batch_size,
+        serve_max_delay_ms=args.serve_max_delay_ms,
+        serve_heartbeat_timeout_s=args.serve_heartbeat_timeout_s,
+        serve_readmit_s=args.serve_readmit_s,
+        serve_requests=args.serve_requests,
     )
     cfg = resolve_tricks(cfg)
     if args.model:

@@ -261,9 +261,9 @@ class AGNewsDataset:
         """Shared tail of both native encode paths: bucket the padded
         [n, max_len] token matrix to the smallest fitting length and
         derive the attention mask from the true lengths."""
-        L = bucket_length(int(lens.max()),
-                          [b for b in self.buckets if b <= max_len]
-                          or [max_len])
+        from faster_distributed_training_tpu.data.loader import (
+            select_bucket)
+        L = select_bucket(int(lens.max()), self.buckets, max_len)
         tokens = tokens_full[:, :L]
         mask = (np.arange(L)[None, :] < lens[:, None]).astype(np.int32)
         return {"tokens": tokens, "token_types": np.zeros_like(tokens),
@@ -306,8 +306,8 @@ class AGNewsDataset:
                        for t in texts]
             pad_id = self.tokenizer.pad_token_id
         longest = max(len(e) for e in encoded)
-        L = bucket_length(longest, [b for b in self.buckets if b <= max_len]
-                          or [max_len])
+        from faster_distributed_training_tpu.data.loader import select_bucket
+        L = select_bucket(longest, self.buckets, max_len)
         tokens = np.full((len(encoded), L), pad_id, np.int32)
         mask = np.zeros((len(encoded), L), np.int32)
         for i, e in enumerate(encoded):

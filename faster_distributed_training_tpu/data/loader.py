@@ -32,6 +32,33 @@ def dataset_len(data) -> int:
     return len(data) if hasattr(data, "encode_batch") else len(data[0])
 
 
+def eligible_buckets(buckets: Sequence[int],
+                     max_len: Optional[int] = None) -> Tuple[int, ...]:
+    """The bucket lengths actually in play at ``max_len``: the
+    configured set capped at max_len, falling back to [max_len] when
+    none fit (a 16-token seq_len on the default (64,...,512) buckets
+    serves one L=16 bucket).  ONE implementation site — encode_batch's
+    filter, the serving queue's bins and run_serving's engine warmup
+    must agree on this set or a request could land in a length no
+    program compiled for."""
+    out = tuple(sorted({int(b) for b in buckets
+                        if max_len is None or b <= max_len}))
+    return out or (int(max_len),)
+
+
+def select_bucket(n: int, buckets: Sequence[int],
+                  max_len: Optional[int] = None) -> int:
+    """The padded length a sequence of ``n`` real tokens runs at: the
+    smallest eligible bucket >= n (the last eligible bucket truncates —
+    data/agnews.py's ``bucket_length`` rule).  This is the ONE
+    bucket-selection rule shared by the training text pipeline
+    (encode_batch) and the serving request queue (serve/queue.py): a
+    serving request lands in a length the training programs already
+    compiled for, so no request mix can retrace."""
+    from faster_distributed_training_tpu.data.agnews import bucket_length
+    return bucket_length(int(n), list(eligible_buckets(buckets, max_len)))
+
+
 def shard_for_host(n: int, epoch: int, seed: int = 0, shuffle: bool = True,
                    process_index: Optional[int] = None,
                    process_count: Optional[int] = None, pad: bool = False):

@@ -72,13 +72,11 @@ def synthetic_agnews(n: int = 512, seed: int = 0, vocab: int = 30522,
 
         def encode_batch(self, indices: Sequence[int], max_len: int = 512
                          ) -> Dict[str, np.ndarray]:
-            from faster_distributed_training_tpu.data.agnews import (
-                bucket_length)
+            from faster_distributed_training_tpu.data.loader import (
+                select_bucket)
             seqs = [self._tokens[i][:max_len - 2] for i in indices]
             longest = max(len(s) + 2 for s in seqs)
-            L = bucket_length(longest,
-                              [b for b in self.buckets if b <= max_len]
-                              or [max_len])
+            L = select_bucket(longest, self.buckets, max_len)
             tokens = np.zeros((len(seqs), L), np.int32)
             mask = np.zeros((len(seqs), L), np.int32)
             for i, s in enumerate(seqs):

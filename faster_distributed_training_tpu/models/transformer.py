@@ -206,6 +206,8 @@ class MultiheadAttention(nn.Module):
                          amax_history_len=self.quant.amax_history_len,
                          margin=self.quant.margin,
                          use_pallas=self.quant.use_pallas,
+                         frozen_scales=getattr(self.quant,
+                                               "frozen_scales", False),
                          dtype=self.dtype, param_dtype=self.param_dtype)
                     if self.quant is not None else None)
         # projection-boundary annotations for a (data, model) mesh
@@ -355,7 +357,9 @@ class PositionalWiseFFN(nn.Module):
             qkw = dict(fmt=self.quant.fmt,
                        amax_history_len=self.quant.amax_history_len,
                        margin=self.quant.margin,
-                       use_pallas=self.quant.use_pallas, **kw)
+                       use_pallas=self.quant.use_pallas,
+                       frozen_scales=getattr(self.quant,
+                                             "frozen_scales", False), **kw)
             dense_0 = QuantDense(self.d_ff, name="Dense_0", **qkw)
             dense_1 = QuantDense(self.d_model, name="Dense_1", **qkw)
         else:

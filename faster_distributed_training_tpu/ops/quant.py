@@ -365,12 +365,25 @@ try:
         over the last input axis — the fused qkv projection's
         (3, h, d_k)); the GEMM itself is always the flattened 2D
         contraction, which is what the Pallas kernel serves.
+
+        ``frozen_scales`` is the INFERENCE mode (the serve/ subsystem's
+        contract): scales come from the RESTORED amax history and the
+        history is never rolled — even when the caller passes
+        ``batch_stats`` as mutable.  Serving N requests is then
+        state-free, the per-request amax reduction disappears from the
+        forward, and two identical requests return bitwise-identical
+        logits regardless of what was served between them (pinned by
+        tests/test_serve.py).  Training keeps the default (False):
+        delayed scaling NEEDS the roll.
         """
         features: object            # int or tuple (DenseGeneral-style)
         fmt: str = "int8"
         amax_history_len: int = 16
         margin: float = 1.0
         use_pallas: Optional[bool] = None   # None = auto; False = tp route
+        frozen_scales: bool = False         # True = inference: restored
+                                            # amax history used, never
+                                            # rolled (serve/engine.py)
         kernel_init: object = nn.initializers.lecun_normal()
         bias_init: object = nn.initializers.zeros
         dtype: object = jnp.float32
@@ -412,7 +425,8 @@ try:
                                             self.margin)
                     sw = scale_from_history(hist_w.value, self.fmt,
                                             self.margin)
-                    if self.is_mutable_collection("batch_stats"):
+                    if (not self.frozen_scales
+                            and self.is_mutable_collection("batch_stats")):
                         hist_x.value = update_amax_history(
                             hist_x.value, tensor_amax(x2d))
                         hist_w.value = update_amax_history(

@@ -1,0 +1,45 @@
+"""serve/ — the batched-inference serving tier above the trained artifact.
+
+Everything below this package optimizes *training*; the north star is a
+production system serving heavy traffic, and this subsystem is that
+missing half: a request queue with continuous/dynamic batching into the
+SAME bucket-padded lengths the training text pipeline compiled for
+(data/loader.select_bucket — no request mix can retrace), an
+AOT-compiled, donation-enabled predict step with params frozen (no
+optimizer state resident, int8/fp8 weights served at the r13 QuantDense
+scale state with the amax history frozen at load), and multi-replica
+dispatch with heartbeat liveness so a dead replica is detached and
+re-admitted without draining the others (the r10/r14 resilience idioms
+at request scope).
+
+Partitioning rule (SNIPPETS [3]): 1D partitioning "is essentially
+always faster for inference/decoding" — serve REPLICATED-per-chip when
+the model fits one chip's HBM, and fall back to a single model-sharded
+replica group only when a model axis says it doesn't
+(cli.run_serving owns the decision; the engine serves either).
+
+Layout:
+  * :mod:`queue_` (``serve.queue``)   — ServeRequest + RequestQueue
+    (bucket-binned FIFO cells, deadline bookkeeping);
+  * :mod:`engine`     — InferenceEngine (per-bucket AOT programs,
+    batch-buffer donation, frozen params) + checkpoint loading through
+    any r14 StorageBackend;
+  * :mod:`scheduler`  — BatchScheduler (drains the queue into
+    (bucket, batch) cells under a max-latency deadline, pads partial
+    batches with masked rows whose outputs are dropped);
+  * :mod:`replicas`   — Replica / ReplicaSet (least-loaded dispatch,
+    heartbeat staleness detach, re-admission).
+"""
+
+from faster_distributed_training_tpu.serve.engine import (  # noqa: F401
+    InferenceEngine, ServingState, load_serving_state, pad_batch)
+from faster_distributed_training_tpu.serve.queue import (  # noqa: F401
+    RequestQueue, ServeRequest)
+from faster_distributed_training_tpu.serve.replicas import (  # noqa: F401
+    Replica, ReplicaSet)
+from faster_distributed_training_tpu.serve.scheduler import (  # noqa: F401
+    BatchScheduler)
+
+__all__ = ["InferenceEngine", "ServingState", "load_serving_state",
+           "pad_batch", "RequestQueue", "ServeRequest", "Replica",
+           "ReplicaSet", "BatchScheduler"]

@@ -64,6 +64,12 @@ by ``"kind"``:
                  leaves under --debug)
   ``flight``     {path, reason}                  (a crash flight dump
                   was written — telemetry/flight.py)
+  ``serve_batch``   {bucket, size, real, pad, replica, dispatch_ms,
+                  attempts}                      (one per dispatched
+                  serving batch — serve/scheduler.py)
+  ``serve_request`` {bucket, len, queue_ms, total_ms, replica}
+                 (one per fulfilled request; len is the raw
+                  pre-truncation length)
 
 The machine-checkable registry of the above is TELEMETRY_SCHEMA below;
 ``scripts/check_telemetry_schema.py`` AST-scans every emission site in
@@ -134,6 +140,12 @@ TELEMETRY_SCHEMA: Dict[str, Optional[frozenset]] = {
                          "top_leaves", "peak_bytes", "bytes_in_use",
                          "expected", "got", "changed_leaves"}),
     "flight": frozenset({"path", "reason"}),
+    # r16 serving tier (serve/scheduler.py) — append-only additions:
+    # one record per dispatched batch, one per fulfilled request
+    "serve_batch": frozenset({"bucket", "size", "real", "pad", "replica",
+                              "dispatch_ms", "attempts"}),
+    "serve_request": frozenset({"bucket", "len", "queue_ms", "total_ms",
+                                "replica"}),
 }
 # kinds that once existed but are no longer emitted (none today): the
 # lint's staleness rule consults this instead of forcing removal from

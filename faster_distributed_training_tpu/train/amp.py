@@ -47,11 +47,17 @@ class QuantPolicy(NamedTuple):
     use_pallas: None = auto (Pallas kernel on TPU when the shape fits
       the VMEM budget); False = force the XLA reference path — the
       tp-mesh capability fallback sets this (Pallas custom calls don't
-      partition over tp, the r11 flash precedent)."""
+      partition over tp, the r11 flash precedent).
+    frozen_scales: inference mode (serve/): quantize at the scales the
+      RESTORED amax history implies and never roll it — serving is
+      state-free and bitwise-reproducible per request
+      (cli.build_model(serving=True) sets it; training must keep
+      False — delayed scaling needs the roll)."""
     fmt: str
     amax_history_len: int = 16
     margin: float = 1.0
     use_pallas: Optional[bool] = None
+    frozen_scales: bool = False
 
 
 def resolve_quant_policy(cfg) -> Optional["QuantPolicy"]:
