@@ -603,46 +603,292 @@ def timed_telemetry_overhead(mode: str, bs: int, steps: int) -> dict:
             "mean_step_ms": round(sum(per_step) / len(per_step) * 1e3, 3)}
 
 
-def timed_restart_mttr() -> dict:
-    """Restart-MTTR arm (r10 pod-coordination PR): a small supervised
-    run with a deterministic injected crash, reporting the goodput
-    tracker's mean time-to-recover per restart — detection latency +
-    supervisor backoff + checkpoint restore (resilience/goodput.py).
-    Single-host own-crash recovery: detection is ~0 and the number is
-    dominated by backoff + restore — the recovery FLOOR a pod-scale
-    incident adds peer-detection latency (bounded by
-    --peer_timeout_s / the FAIL-marker poll cadence) on top of.  The
-    training itself is tiny by design: MTTR measures the recovery
-    machinery, not the workload."""
+# inline child for the relaunch-MTTR arms: one tiny supervised-config
+# training run against a shared checkpoint dir; the crash phase dies on
+# an injected fault AFTER a committed cadence save, the relaunch phase
+# auto-resumes and prints its recovery decomposition (restore seconds
+# from goodput, program-acquisition seconds from the observatory feed).
+_RELAUNCH_CHILD = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["FDT_BENCH_REPO"])
+from faster_distributed_training_tpu.config import TrainConfig
+from faster_distributed_training_tpu.cli import run_training
+cfg = TrainConfig(model="transformer", dataset="synthetic", num_classes=4,
+                  batch_size=8, seq_len=16, n_layers=1, d_model=16, d_ff=32,
+                  n_heads=2, epochs=2, subset_stride=64, optimizer="sgd",
+                  precision="fp32", plot=False, workers=0, log_every=0,
+                  donate=False, checkpoint_dir=os.environ["FDT_BENCH_DIR"],
+                  checkpoint_every=4,
+                  executable_cache=os.environ.get("FDT_BENCH_EXEC_CACHE", ""))
+out = run_training(cfg, log=lambda *a: print(*a, file=sys.stderr))
+print(json.dumps({"step": int(out["state"].step),
+                  "restore_s": float(out.get("goodput_restore_s", 0.0)),
+                  "compile_s": float(out.get("goodput_compile_s", 0.0)),
+                  "restores": int(out.get("goodput_restores", 0))}))
+"""
+
+
+def timed_restart_mttr(cache: bool = False) -> dict:
+    """Restart-MTTR arm, r17 definition: the recovery cost of a
+    RELAUNCHED process — crash phase (injected fault after a committed
+    cadence save) then a fresh process that auto-resumes — which is the
+    scenario a restarted/rejoining slice actually pays.  MTTR = the
+    relaunch's checkpoint-restore seconds + its program-acquisition
+    seconds (every compile in a relaunch is recovery recompile; with
+    ``cache`` the executable tier deserializes instead —
+    restart_cached_mttr_s vs restart_mttr_s is the tentpole A/B).
+    detect/backoff are 0 by scenario: a platform relaunch's detection
+    is platform-side and the r17 supervisor's first restart is
+    immediate.  Pre-r17 this arm measured the IN-process supervised
+    cycle, which keeps its compiled programs alive and therefore could
+    never see the compile-dominated half of real-hardware MTTR — the
+    old number survives in goodput's restart_mttr_s for supervised
+    runs.  Both phases run against a HERMETIC XLA compilation-cache
+    dir: a developer's warm ~/.cache would otherwise serve the crash
+    phase's compiles and (XLA:CPU) cache-served executables don't
+    serialize round-trippably, making the arm measure the machine's
+    history instead of the cache tier."""
     import shutil
+    import subprocess as sp
     import tempfile
 
-    from faster_distributed_training_tpu.cli import run_training
-    from faster_distributed_training_tpu.config import TrainConfig
-    from faster_distributed_training_tpu.resilience import (
-        faults as faults_mod)
-
     d = tempfile.mkdtemp(prefix="fdt_bench_mttr_")
-    die_at = int(os.environ.get("FDT_BENCH_MTTR_DIE_AT", "6"))
-    os.environ[faults_mod.ENV_DIE] = str(die_at)
-    cfg = TrainConfig(model="transformer", dataset="synthetic",
-                      num_classes=4, batch_size=8, seq_len=16, n_layers=1,
-                      d_model=16, d_ff=32, n_heads=2, epochs=2,
-                      subset_stride=64, optimizer="sgd", precision="fp32",
-                      plot=False, workers=0, log_every=0, donate=False,
-                      checkpoint_dir=d, checkpoint_every=4, supervise=True)
+    die_at = int(os.environ.get("FDT_BENCH_MTTR_DIE_AT", "13"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    xla_dirs = []
+
+    def phase(extra, expect_fail=False):
+        # one hermetic XLA cache dir PER PHASE: the persistent dir is
+        # machine-local and a relaunched slice on a fresh machine only
+        # keeps the (durable, StorageBackend-backed) executable cache —
+        # the tier this arm A/Bs
+        xla_dirs.append(tempfile.mkdtemp(prefix="fdt_bench_mttr_xla_"))
+        env = dict(os.environ, FDT_BENCH_DIR=d, FDT_BENCH_REPO=repo,
+                   FDT_COMPILATION_CACHE=xla_dirs[-1],
+                   FDT_BENCH_EXEC_CACHE="on" if cache else "0", **extra)
+        env.pop("FDT_BENCH_CHILD", None)
+        p = sp.run([sys.executable, "-c", _RELAUNCH_CHILD], env=env,
+                   capture_output=True, text=True, timeout=900)
+        if expect_fail:
+            if p.returncode == 0:
+                # a disarmed fault would silently turn the "relaunch"
+                # into resume-from-a-completed-run and commit bogus
+                # MTTR numbers — fail the arm loudly instead
+                raise RuntimeError(
+                    "crash phase was expected to die on the injected "
+                    "fault but exited cleanly (fault not armed?)")
+            return None
+        if p.returncode != 0:
+            raise RuntimeError(f"relaunch child rc={p.returncode}: "
+                               f"{p.stderr[-1500:]}")
+        return json.loads(p.stdout.strip().splitlines()[-1])
+
     try:
-        out = run_training(cfg, log=lambda *_: None)
+        phase({"FDT_FAULT_DIE_AT_STEP": str(die_at)}, expect_fail=True)
+        out = phase({})
+        sources = {}
+        try:
+            with open(os.path.join(d, "telemetry", "manifest.json")) as f:
+                man = json.load(f)
+            for prog in man.get("compile", {}).get("programs", []):
+                sources[prog["name"]] = [v.get("cache_source", "?")
+                                         for v in prog["variants"]]
+        except (OSError, ValueError, KeyError):
+            pass
     finally:
-        os.environ.pop(faults_mod.ENV_DIE, None)
         shutil.rmtree(d, ignore_errors=True)
-    return {"restart_mttr_s": float(out.get("goodput_restart_mttr_s", 0.0)),
-            "restore_s": round(float(out.get("goodput_restore_s", 0.0)), 3),
-            "backoff_s": round(
-                float(out.get("goodput_restart_backoff_s", 0.0)), 3),
-            "detect_s": round(float(out.get("goodput_detect_s", 0.0)), 3),
-            "restarts": int(out.get("goodput_restarts", 0)),
-            "die_at": die_at}
+        for x in xla_dirs:
+            shutil.rmtree(x, ignore_errors=True)
+    restore = round(out["restore_s"], 3)
+    compile_ = round(out["compile_s"], 3)
+    return {"mttr_s": round(restore + compile_, 3),
+            "restore_s": restore, "compile_s": compile_,
+            "detect_s": 0.0, "backoff_s": 0.0,
+            "restores": int(out["restores"]), "die_at": die_at,
+            "cache": bool(cache), "cache_sources": sources}
+
+
+def timed_warm_spare() -> dict:
+    """Warm-spare swap arm (r17 tentpole): a simulated 2-slice pod (one
+    host thread per slice) plus ONE parked spare thread whose step
+    program is already built — slice 1 is killed for good (no restart
+    budget), the survivor holds, the spare claims the seat, restores,
+    catches up, and finishes the run in slice 1's place.  Reports
+    warm_spare_swap_s (claim -> release, published by the spare's
+    goodput summary beside the badput segments)
+    and warm_spare_hold_s (the survivor's parked time) — the numbers
+    the cold-rejoin twin pays a process relaunch + full recompile for.
+    Training is tiny by design: the arm measures the swap machinery."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from faster_distributed_training_tpu.config import TrainConfig
+    from faster_distributed_training_tpu.models import Transformer
+    from faster_distributed_training_tpu.optim import build_optimizer
+    from faster_distributed_training_tpu.resilience import (
+        AsyncCheckpointManager, FaultPlan, GoodputTracker, PodCoordinator,
+        Supervisor)
+    from faster_distributed_training_tpu.train import (create_train_state,
+                                                       make_train_step)
+
+    cfg = TrainConfig(model="transformer", dataset="agnews", num_classes=4,
+                      batch_size=4, seq_len=8, optimizer="sgd",
+                      precision="fp32", epochs=1, donate=False)
+    model = Transformer(n_class=4, vocab=32, n_layers=1, h=2, d_model=16,
+                        d_ff=32, d_hidden=16, maxlen=8)
+    tx, _ = build_optimizer(cfg, steps_per_epoch=2)
+    state0 = create_train_state(model, tx, jnp.zeros((4, 8), jnp.int32),
+                                jax.random.PRNGKey(0),
+                                init_kwargs={"train": True})
+    batch = {"tokens": _np.random.default_rng(0).integers(
+                 0, 32, size=(4, 8)).astype(_np.int32),
+             "label": _np.arange(4, dtype=_np.int32) % 4}
+    step_fn = jax.jit(make_train_step(cfg))
+    step_fn(state0, batch)          # the spare's programs are warm
+    total, every = 12, 4
+    die_at = int(os.environ.get("FDT_BENCH_SPARE_DIE_AT", "6"))
+    d = tempfile.mkdtemp(prefix="fdt_bench_spare_")
+    goodputs = [GoodputTracker().start() for _ in range(3)]
+    # loose lockstep between the two MEMBERS until the kill (the r14
+    # harness idiom): without it a scheduling hiccup lets the survivor
+    # run ahead into a cadence save whose commit barrier can only wait
+    # out the dead peer — the hold would measure the commit timeout,
+    # not the swap
+    barrier = threading.Barrier(2)
+
+    def member(pi, faults, budget):
+        coord = PodCoordinator(
+            os.path.join(d, "_pod"), process_index=pi, process_count=2,
+            sync_every=1, peer_timeout_s=30.0, slice_index=pi,
+            slice_count=2, readmit_timeout_s=60.0,
+            goodput=goodputs[pi], log=lambda *_: None)
+        mgr = AsyncCheckpointManager(
+            d, every_steps=every, process_index=pi, process_count=2,
+            shard_owner=((lambda sh: sh.replica_id == 0) if pi == 0
+                         else (lambda sh: False)),
+            commit_timeout_s=15.0,
+            step_gather_fn=coord.gather_restored_step,
+            goodput=goodputs[pi], log=lambda *_: None)
+        coord.drain_fn = mgr.wait
+        sup = Supervisor(max_restarts=budget, backoff_base=0.01,
+                         goodput=goodputs[pi], log=lambda *_: None,
+                         coordinator=coord)
+        progress = {"step": 0}
+
+        def attempt(_i):
+            try:
+                st, start = state0, 0
+                got = mgr.restore_latest(st)
+                if got is not None:
+                    st, meta = got
+                    start = int(meta["step"])
+                progress["step"] = start
+                if coord.rejoining:
+                    coord.rejoin_sync(start)
+                with coord.watch_steps():
+                    for i in range(start + 1, total + 1):
+                        try:
+                            barrier.wait(timeout=30.0)
+                        except threading.BrokenBarrierError:
+                            time.sleep(0.01)   # pace the free run
+                        st, _m = step_fn(st, batch)
+                        progress["step"] = i
+                        if faults is not None:
+                            faults.on_step(i)
+                        coord.check(i)
+                        align = coord.consume_cadence_align()
+                        if align is not None:
+                            mgr.align_cadence(align)
+                        if not coord.saves_suspended:
+                            mgr.maybe_save(st, i)
+                mgr.wait()
+                return st
+            except BaseException:
+                barrier.abort()
+                raise
+        try:
+            # the supervisor records completion on the coordinator
+            return sup.run(attempt, lambda: progress["step"])
+        finally:
+            barrier.abort()      # a finished member frees the other side
+            mgr.close()
+            coord.close()
+
+    def spare():
+        coord = PodCoordinator(
+            os.path.join(d, "_pod"), process_index=0, process_count=2,
+            sync_every=1, peer_timeout_s=30.0, slice_count=2,
+            readmit_timeout_s=60.0, spare_index=0,
+            goodput=goodputs[2], log=lambda *_: None)
+        claim = coord.spare_wait(poll_s=0.02)
+        if claim is None:
+            coord.close()
+            return None
+        mgr = AsyncCheckpointManager(
+            d, every_steps=every, process_index=coord.pi, process_count=2,
+            shard_owner=(lambda sh: False), commit_timeout_s=15.0,
+            step_gather_fn=coord.gather_restored_step,
+            goodput=goodputs[2], log=lambda *_: None)
+        coord.drain_fn = mgr.wait
+        try:
+            st, start = state0, 0
+            got = mgr.restore_latest(st)
+            if got is not None:
+                st, meta = got
+                start = int(meta["step"])
+            coord.rejoin_sync(start)
+            with coord.watch_steps():
+                for i in range(start + 1, total + 1):
+                    st, _m = step_fn(st, batch)
+                    coord.check(i)
+                    align = coord.consume_cadence_align()
+                    if align is not None:
+                        mgr.align_cadence(align)
+                    if not coord.saves_suspended:
+                        mgr.maybe_save(st, i)
+            mgr.wait()
+            coord.record_completion(step=total)
+            return st
+        finally:
+            mgr.close()
+            coord.close()
+
+    errors = {}
+
+    def body(label, fn, *a):
+        try:
+            fn(*a)
+        except BaseException as e:          # pragma: no cover - reported
+            if label != "victim":
+                errors[label] = repr(e)
+
+    threads = [
+        threading.Thread(target=body, args=("survivor", member, 0, None, 3),
+                         daemon=True),
+        threading.Thread(target=body,
+                         args=("victim", member, 1,
+                               FaultPlan(die_at=die_at), 0),
+                         daemon=True),
+        threading.Thread(target=body, args=("spare", spare), daemon=True)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    shutil.rmtree(d, ignore_errors=True)
+    s0, s2 = goodputs[0].summary(), goodputs[2].summary()
+    return {"warm_spare_swap_s": round(
+                float(s2.get("warm_spare_swap_s", 0.0)), 3),
+            "warm_spare_hold_s": round(
+                float(s0.get("readmission_hold_s", 0.0)), 3),
+            "claims": int(s2.get("warm_spare_claims", 0)),
+            "swaps": int(s2.get("warm_spare_swaps", 0)),
+            "survivor_restarts": int(s0.get("restarts", 0)),
+            "errors": errors, "die_at": die_at}
 
 
 def timed_restart_slice_mttr() -> dict:
@@ -1183,6 +1429,10 @@ PRODUCED_METRIC_PATTERNS = (
     "ckpt_*_blocking_ms_per_save", "ckpt_*_overhead_pct",
     "restart_mttr_s", "restart_mttr_*_s",
     "restart_slice_mttr_s", "restart_slice_mttr_*_s",
+    # r17 instant restart: cached-relaunch twin + warm-spare swap
+    "restart_cached_mttr_s", "restart_cached_mttr_*_s",
+    "restart_cached_deserialized_programs",
+    "warm_spare_swap_s", "warm_spare_hold_s",
     "telem_on_median_step_ms", "telem_off_median_step_ms",
     "telemetry_overhead_pct",
     "transformer_bs256_seq256_quant_off_step_ms",   # r13 quant A/B
@@ -1505,9 +1755,18 @@ def main() -> None:
             child[len("ckpt_"):], cbs, csteps)))
         return
     if child == "restart_mttr":
-        # r10 resilience arm: one supervised crash-and-recover cycle,
-        # MTTR decomposition from the goodput tracker
-        print(json.dumps(timed_restart_mttr()))
+        # r17 resilience arm: crash + COLD process relaunch — the
+        # restore + full-recompile recovery a restarted slice pays
+        print(json.dumps(timed_restart_mttr(cache=False)))
+        return
+    if child == "restart_cached_mttr":
+        # r17 tentpole A/B twin: the same relaunch with the persistent
+        # executable cache armed — programs deserialize, not recompile
+        print(json.dumps(timed_restart_mttr(cache=True)))
+        return
+    if child == "warm_spare":
+        # r17 tentpole arm: parked spare claims a killed slice's seat
+        print(json.dumps(timed_warm_spare()))
         return
     if child == "restart_slice_mttr":
         # r14 elastic-recovery arm: simulated 2-slice pod, one slice
@@ -1839,18 +2098,43 @@ def main() -> None:
                     record[f"ckpt_{m}_amortized_overhead_pct"] = round(
                         (ck[m]["mean_step_ms"] - ck["off"]["mean_step_ms"])
                         / ck["off"]["mean_step_ms"] * 100.0, 2)
-            # Restart MTTR (r10 pod-coordination arm): the wall cost of
-            # ONE supervised crash-and-recover cycle — detect + backoff
-            # + restore per restart, the recovery floor a pod incident
-            # adds peer-detection latency on top of (see
-            # timed_restart_mttr; components published beside the
-            # headline so a regression names its segment).
+            # Restart MTTR (redefined r17 — see timed_restart_mttr):
+            # crash + COLD process relaunch, MTTR = restore + full
+            # program recompile, split into its components.  The old
+            # in-process supervised number (which keeps compiled
+            # programs alive and, post backoff-fix, reduces to
+            # restore_s) lives on in every supervised run's goodput
+            # summary; detect/backoff publish 0.0 here by scenario
+            # (platform relaunch + immediate first restart).
             mt = _run_child("restart_mttr")
-            if mt and mt.get("restarts"):
-                record["restart_mttr_s"] = mt["restart_mttr_s"]
+            if mt and mt.get("restores"):
+                record["restart_mttr_s"] = mt["mttr_s"]
                 record["restart_mttr_restore_s"] = mt["restore_s"]
+                record["restart_mttr_compile_s"] = mt["compile_s"]
                 record["restart_mttr_backoff_s"] = mt["backoff_s"]
                 record["restart_mttr_detect_s"] = mt["detect_s"]
+            # ...and the executable-cache twin (r17 tentpole A/B): the
+            # SAME relaunch with --executable_cache on — programs
+            # deserialize (cache_source=deserialized) instead of
+            # recompiling; restart_cached_mttr_s < restart_mttr_s is
+            # the committed win.
+            cmt = _run_child("restart_cached_mttr")
+            if cmt and cmt.get("restores"):
+                record["restart_cached_mttr_s"] = cmt["mttr_s"]
+                record["restart_cached_mttr_restore_s"] = cmt["restore_s"]
+                record["restart_cached_mttr_compile_s"] = cmt["compile_s"]
+                srcs = [s for v in cmt.get("cache_sources", {}).values()
+                        for s in v]
+                record["restart_cached_deserialized_programs"] = sum(
+                    1 for s in srcs if s == "deserialized")
+            # Warm-spare swap (r17 tentpole arm): a parked spare claims
+            # a killed slice's seat — swap wall time (claim->release)
+            # and the survivor's hold; the headline awaits real TPU
+            # hardware, but the arm commits the machinery's number.
+            ws = _run_child("warm_spare")
+            if ws and ws.get("swaps"):
+                record["warm_spare_swap_s"] = ws["warm_spare_swap_s"]
+                record["warm_spare_hold_s"] = ws["warm_spare_hold_s"]
             # Slice-recovery MTTR (r14 elastic-recovery arm): one
             # slice killed and RE-ADMITTED while the other holds —
             # detect + hold + restore per readmission (see
@@ -2146,7 +2430,9 @@ def _essentials(record: dict) -> dict:
             "tricks_speedup_x", "ckpt_async_overhead_pct",
             "ckpt_async_amortized_overhead_pct",
             "ckpt_async_sharded_overhead_pct", "restart_mttr_s",
-            "restart_slice_mttr_s",
+            "restart_mttr_compile_s", "restart_mttr_restore_s",
+            "restart_cached_mttr_s", "restart_slice_mttr_s",
+            "warm_spare_swap_s",
             "serve_p50_ms", "serve_p99_ms", "serve_qps_per_chip",
             "telemetry_overhead_pct",
             "transformer_bs256_seq256_quant_off_step_ms",

@@ -670,6 +670,65 @@ def make_loaders(cfg: TrainConfig, train_ds, eval_ds, dp: int = 1
     return train_loader, eval_loader, max(steps, 1)
 
 
+def _warm_spare_park(trainer, state, res, train_loader, eval_loader,
+                     telemetry, log) -> Optional[dict]:
+    """Warm-spare pre-admission (r17): warm the steady-state programs
+    through the observatory (and its executable cache, when armed) and
+    park on the coordinator until a failed slice's seat is claimable.
+    Each new COMMIT is restored once as a PROBE — proving the newest
+    checkpoint restorable and keeping the storage medium warm before
+    the swap depends on it — but the restored tree is deliberately NOT
+    retained: holding a second full state resident would double the
+    spare's HBM footprint for the whole park, and the post-claim
+    attempt path re-restores through the slice-scoped barrier anyway
+    (restore is the cheap half of MTTR; the programs are the warm
+    part).  Returns the claim dict after ``Resilience.adopt_seat``
+    re-keys the bundle (the caller then runs the normal supervised
+    attempt path: the coordinator is already rejoining under the
+    adopted identity), or None when the pod completed incident-free."""
+    from faster_distributed_training_tpu.telemetry import spans
+
+    coord = res.coordinator
+    log(f"[spare] warm spare {coord.spare_index} pre-admitting: warming "
+        f"programs + restoring to the last COMMIT")
+    with spans.span("spare_warm"):
+        warmed = trainer.warm_programs(state, train_loader, eval_loader)
+    log(f"[spare] {warmed} program(s) warm; parking for incidents "
+        f"(claim = first CLAIM marker writer wins)")
+    if telemetry is not None:
+        telemetry.recorder.record_event("spare", event="parked",
+                                        spare=int(coord.spare_index))
+    warm = {"step": -1}
+
+    def refresh():
+        if res.manager is None:
+            return
+        newest = res.manager.latest_valid()
+        if newest is None or newest[0] <= warm["step"]:
+            return
+        got = res.manager.peek_latest(state)
+        if got is not None:
+            _st, meta = got      # restorability probe only — dropped
+            warm["step"] = int(meta.get("step", newest[0]))
+            log(f"[spare] COMMIT step {warm['step']} verified restorable")
+
+    claim = coord.spare_wait(refresh_fn=refresh)
+    if claim is None:
+        if telemetry is not None:
+            telemetry.recorder.record_event(
+                "spare", event="stood_down", spare=int(coord.spare_index))
+        return None
+    res.adopt_seat(claim["seat"])
+    if telemetry is not None:
+        fields = {"event": "claimed", "spare": int(coord.spare_index),
+                  "seat": int(claim["seat"]),
+                  "generation": int(claim["generation"]),
+                  "step": int(warm["step"])}
+        fields["slice"] = int(claim["slice"])
+        telemetry.recorder.record_event("spare", **fields)
+    return claim
+
+
 def run_training(cfg: TrainConfig,
                  log: Callable[[str], None] = print) -> dict:
     """Full training run; returns {'state','history','best_acc','cfg'}."""
@@ -880,6 +939,20 @@ def run_training(cfg: TrainConfig,
         log(f"[telemetry] recording to {telemetry.directory} "
             f"(host {telemetry.pi}/{telemetry.pc}; disable with "
             f"--no_telemetry or FDT_TELEMETRY=0)")
+    if telemetry is not None and telemetry.observatory is not None:
+        # r17 instant restart: the persistent executable cache rides the
+        # compile observatory (lookup-before-compile / store-after-
+        # compile — a restarted process deserializes its programs,
+        # cache_source=deserialized in the manifest compile table), and
+        # the observatory feeds program-acquisition seconds to goodput
+        # so restart MTTR splits into compile vs restore components
+        from faster_distributed_training_tpu.resilience.executable_cache \
+            import build_executable_cache
+        telemetry.observatory.executable_cache = build_executable_cache(
+            cfg, backend=res.backend if res is not None else None,
+            mesh=mesh, log=log)
+        if res is not None:
+            telemetry.observatory.goodput = res.goodput
     profiler = None
     window = parse_profile_steps(cfg.profile_steps)
     if window is not None:
@@ -966,7 +1039,25 @@ def run_training(cfg: TrainConfig,
 
         with trace_profile("./profile" if cfg.profile else None):
             try:
-                if res is not None and cfg.supervise:
+                spare_stood_down = False
+                if (res is not None and res.coordinator is not None
+                        and res.coordinator.spare_index is not None):
+                    # r17 warm spare: pre-admit (programs warmed through
+                    # the executable cache, params restored to the last
+                    # COMMIT + refreshed) and park until a failed seat
+                    # is claimable; on a claim the coordinator is
+                    # already in rejoin mode under the adopted identity
+                    # and the NORMAL supervised attempt path below runs
+                    # the swap (restore through the slice barrier, catch
+                    # up, RJREADY, release, then train to completion)
+                    claim = _warm_spare_park(trainer, state, res,
+                                             train_loader, eval_loader,
+                                             telemetry, log)
+                    spare_stood_down = claim is None
+                if spare_stood_down:
+                    log("[spare] pod completed without an incident; "
+                        "spare stands down (state untouched)")
+                elif res is not None and cfg.supervise:
                     # coordinator (pods / --step_timeout_s): every attempt
                     # enters the shared-fs generation rendezvous and every
                     # failure is published as a FAIL marker BEFORE the
@@ -1081,6 +1172,8 @@ def run_serving(cfg: TrainConfig, requests=None,
     sharded = tp_size(mesh) > 1 or sp_size(mesh) > 1
     recorder = None
     prev_rec = None
+    obs = None
+    prev_obs = None
     if cfg.telemetry and os.environ.get("FDT_TELEMETRY", "1") != "0":
         import dataclasses
         import time as time_mod
@@ -1095,6 +1188,31 @@ def run_serving(cfg: TrainConfig, requests=None,
             "unix_time": round(time_mod.time(), 3),
             "config": dataclasses.asdict(cfg)}})
         prev_rec = spans.set_recorder(recorder)
+        # r17: serving gets its own compile observatory (run_training's
+        # never existed in this process), so the engines' AOT warmups
+        # observe through it — and through the persistent executable
+        # cache when armed, a restarted serving replica deserializes
+        # its serve:predict:L<bucket> programs instead of recompiling
+        from faster_distributed_training_tpu.telemetry import (
+            ProgramObservatory, programs)
+        if programs.observatory_enabled():
+            obs = ProgramObservatory(recorder=recorder, log=log)
+            from faster_distributed_training_tpu.resilience \
+                .executable_cache import build_executable_cache
+            from faster_distributed_training_tpu.resilience.storage \
+                import build_backend
+            # the cache rides the SAME configured backend serving's
+            # checkpoint loads do — a posix default here would strand
+            # the entries on the local disk while the deployment's
+            # durable medium (the one a replica restarted on another
+            # machine can reach) is an object store
+            obs.executable_cache = build_executable_cache(
+                cfg,
+                backend=build_backend(
+                    getattr(cfg, "storage_backend", "posix"),
+                    cfg.checkpoint_dir, log=log),
+                mesh=mesh if sharded else None, log=log)
+            prev_obs = programs.set_observatory(obs)
         log(f"[serve] telemetry recording to {tdir}")
     try:
         model, sstate, meta = load_serving_state(
@@ -1162,6 +1280,15 @@ def run_serving(cfg: TrainConfig, requests=None,
         return out
     finally:
         if recorder is not None:
+            if obs is not None:
+                from faster_distributed_training_tpu.telemetry import (
+                    programs, update_manifest as _upd)
+                programs.set_observatory(prev_obs)
+                # the serve compile story under its OWN manifest key —
+                # merging into "compile" would clobber the training
+                # run's program table (the r16 lesson, kept)
+                _upd(recorder.directory,
+                     {"serve_compile": obs.summary()})
             spans.set_recorder(prev_rec)
             recorder.close()
 

@@ -296,6 +296,48 @@ class TrainConfig:
                                       # 0 = disable re-admission (every
                                       # failure restarts the whole pod, the
                                       # r10 behavior)
+    commit_timeout_s: float = 0.0     # sharded-checkpoint commit-barrier
+                                      # timeout.  0 = auto: tied to
+                                      # O(peer_timeout_s) (max(2x, 10s))
+                                      # whenever the pod coordinator is
+                                      # armed — a 600s barrier that
+                                      # outlives peer detection turns
+                                      # every re-admission hold into a
+                                      # pod_fallback_restart (r14 follow-
+                                      # on) — else the historic 600s.
+                                      # User values that invert the
+                                      # ordering (below peer_timeout_s, or
+                                      # above readmit_timeout_s) warn
+    executable_cache: str = ""        # persistent EXECUTABLE cache
+                                      # (resilience/executable_cache.py):
+                                      # "" = off, "on" =
+                                      # <checkpoint_dir>/_exec_cache
+                                      # through the storage backend, else
+                                      # an explicit directory.  A
+                                      # restarted/rejoining process
+                                      # deserializes its compiled (train,
+                                      # eval, reshard, serve-predict)
+                                      # programs instead of recompiling
+                                      # (cache_source=deserialized in the
+                                      # manifest compile table); keyed by
+                                      # HLO fingerprint + jax/jaxlib +
+                                      # device kind + mesh; corrupt
+                                      # entries degrade to plain compile.
+                                      # Env seam: FDT_EXEC_CACHE (0=off)
+    warm_spares: int = 0              # launcher-side contract (r17): how
+                                      # many STANDBY spare processes to
+                                      # launch beside the pod, each with
+                                      # FDT_SLICE_SPARE=<id> (and an out-
+                                      # of-pod FDT_POD_INDEX).  A spare
+                                      # pre-admits — mesh built, programs
+                                      # warmed via the executable cache,
+                                      # params restored to the last COMMIT
+                                      # and refreshed at each new one —
+                                      # and claims a failed slice's seat
+                                      # at re-admission time (CLAIM
+                                      # marker, first writer wins).  The
+                                      # training process itself reads
+                                      # FDT_SLICE_SPARE, not this flag
 
     # -- serving (serve/ package; cli.run_serving) -------------------------
     serve_replicas: int = 0           # inference replicas: 0 = auto (one
@@ -519,6 +561,26 @@ def build_parser(prog: str = "fdt",
                         "how long surviving slices hold for a failed "
                         "slice's restart + re-admission before falling "
                         "back to a whole-pod restart (0 = always whole-pod)")
+    p.add_argument("--commit_timeout_s", default=d.commit_timeout_s,
+                   type=float,
+                   help="sharded-checkpoint commit-barrier timeout (0 = "
+                        "auto: max(2 x peer_timeout_s, 10s) when the pod "
+                        "coordinator is armed, else 600s); values that "
+                        "invert the detection/hold ordering warn")
+    p.add_argument("--executable_cache", default=d.executable_cache,
+                   help="persistent executable cache: '' = off, 'on' = "
+                        "<checkpoint_dir>/_exec_cache via the storage "
+                        "backend, else an explicit directory — a "
+                        "restarted process deserializes its compiled "
+                        "programs instead of recompiling (restart MTTR "
+                        "is compile-dominated on real hardware); "
+                        "FDT_EXEC_CACHE overrides (0 = kill)")
+    p.add_argument("--warm_spares", default=d.warm_spares, type=int,
+                   help="launcher contract: standby spare processes to "
+                        "run beside the pod (each sets "
+                        "FDT_SLICE_SPARE=<id>); a spare pre-admits and "
+                        "claims a failed slice's seat at re-admission "
+                        "time instead of waiting out a cold restart")
     p.add_argument("--debug", action="store_true",
                    help="per-epoch NGD Fisher invariant self-tests")
     p.add_argument("--data_path", default=d.data_path,
@@ -671,6 +733,9 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         step_timeout_s=args.step_timeout_s,
         storage_backend=args.storage_backend,
         readmit_timeout_s=args.readmit_timeout_s,
+        commit_timeout_s=args.commit_timeout_s,
+        executable_cache=args.executable_cache,
+        warm_spares=args.warm_spares,
         data_path=args.data_path,
         resident_layout=args.resident_layout,
         steps_per_dispatch=args.steps_per_dispatch,

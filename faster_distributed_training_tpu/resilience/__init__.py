@@ -62,7 +62,10 @@ from faster_distributed_training_tpu.resilience.storage import (  # noqa: E402,F
 from faster_distributed_training_tpu.resilience.goodput import (  # noqa: E402,F401,E501
     GoodputTracker)
 from faster_distributed_training_tpu.resilience.coordinator import (  # noqa: E402,F401,E501
-    PeerFailure, PodCoordinator, StepTimeout, pod_identity, slice_identity)
+    PeerFailure, PodCoordinator, SeatTaken, StepTimeout, pod_identity,
+    slice_identity, spare_identity)
+from faster_distributed_training_tpu.resilience.executable_cache import (  # noqa: E402,F401,E501
+    ExecutableCache, build_executable_cache)
 from faster_distributed_training_tpu.resilience.manager import (  # noqa: E402,F401,E501
     AsyncCheckpointManager, RestoreDivergence)
 from faster_distributed_training_tpu.resilience.preemption import (  # noqa: E402,F401,E501
@@ -96,6 +99,21 @@ class Resilience:
     slice_index: int = 0
     slice_count: int = 1
     backend: Optional[StorageBackend] = None
+    spare_index: Optional[int] = None
+
+    def adopt_seat(self, seat: int) -> None:
+        """r17 warm spares: after the coordinator claimed a failed pod
+        seat (``PodCoordinator._adopt_seat``), re-key the rest of the
+        bundle — the manager's shard ownership / commit-barrier role
+        and the pod identity the train loop gates per-host behavior on
+        (e.g. host-0-only epoch saves on fs-simulated pods)."""
+        self.pod_index = int(seat)
+        self.slice_index = (self.coordinator.si
+                            if self.coordinator is not None else 0)
+        if self.manager is not None:
+            self.manager.adopt_identity(
+                seat, shard_owner=(_sim_shard_owner(seat)
+                                   if self.pod_simulated else None))
 
     def close(self) -> None:
         if self.manager is not None:
@@ -104,6 +122,16 @@ class Resilience:
             self.preemption.uninstall()
         if self.coordinator is not None:
             self.coordinator.close()
+
+
+def _sim_shard_owner(pi: int):
+    """The fs-SIMULATED pod's shard-ownership policy (one place, used
+    at build time and again when a warm spare adopts a seat): host 0
+    writes the full replica-0 cover, every other host writes an empty
+    shard set whose DONE marker the commit barrier still requires."""
+    if pi == 0:
+        return lambda sh: sh.replica_id == 0
+    return lambda sh: False
 
 
 def build_resilience(cfg, log: Callable[[str], None] = print
@@ -136,10 +164,22 @@ def build_resilience(cfg, log: Callable[[str], None] = print
     failed slice restarts and rejoins; whole-pod restart remains the
     fallback)."""
     pi, pc, simulated = pod_identity()
+    spare = spare_identity()
+    if spare is not None:
+        # a warm spare is NOT one of the pod's pc members: park it under
+        # a synthetic out-of-pod index (markers, shard files, telemetry
+        # can never collide with a member's) until it claims a seat and
+        # Resilience.adopt_seat re-keys the bundle
+        pi = pc + spare
     si, sc, _slice_sim = slice_identity(process_index=pi, process_count=pc)
     faults = FaultPlan.from_env(process_index=pi)
     cadence = bool(cfg.checkpoint_every or cfg.checkpoint_every_secs)
     step_timeout = float(getattr(cfg, "step_timeout_s", 0.0) or 0.0)
+    if spare is not None and not cfg.supervise:
+        log("[resilience] WARNING: FDT_SLICE_SPARE is set but --supervise "
+            "is not — the warm-spare park lives on the pod coordinator, "
+            "which only the supervised path builds; this process will "
+            "train as an ordinary (out-of-pod!) run instead of parking")
     if step_timeout > 0 and not cfg.supervise:
         # BEFORE the enablement gate: --step_timeout_s as the ONLY
         # resilience flag must still warn, not silently no-op
@@ -158,36 +198,58 @@ def build_resilience(cfg, log: Callable[[str], None] = print
         getattr(cfg, "storage_backend", "posix"), cfg.checkpoint_dir,
         log=log)
     goodput = GoodputTracker()
+    peer_timeout = float(getattr(cfg, "peer_timeout_s", 60.0))
+    readmit_timeout = float(getattr(cfg, "readmit_timeout_s", 60.0))
     coordinator = None
-    if cfg.supervise and (pc > 1 or step_timeout > 0):
+    if cfg.supervise and (pc > 1 or step_timeout > 0 or spare is not None):
         coordinator = PodCoordinator(
             os.path.join(cfg.checkpoint_dir, "_pod"),
             process_index=pi, process_count=pc,
             sync_every=cfg.preempt_sync_every,
-            peer_timeout_s=float(getattr(cfg, "peer_timeout_s", 60.0)),
+            peer_timeout_s=peer_timeout,
             step_timeout_s=step_timeout,
             slice_index=si, slice_count=sc,
-            readmit_timeout_s=float(
-                getattr(cfg, "readmit_timeout_s", 60.0)),
-            backend=backend,
+            readmit_timeout_s=readmit_timeout,
+            backend=backend, spare_index=spare,
             goodput=goodput, log=log)
+    # commit-barrier timeout tied to the peer-detection timescale when
+    # both are armed (r14 follow-on, now the default everywhere a
+    # coordinator exists — not just simulated pods): the manager's old
+    # 600 s default outlives both peer detection AND the re-admission
+    # hold window, so a commit barrier stuck on a dead host burned the
+    # whole hold into a pod_fallback_restart before anything timed out.
+    # O(peer_timeout) keeps the ordering detection < barrier give-up.
+    commit_timeout = float(getattr(cfg, "commit_timeout_s", 0.0) or 0.0)
+    if commit_timeout <= 0:
+        commit_timeout = (max(2.0 * peer_timeout, 10.0)
+                          if coordinator is not None and pc > 1 else 600.0)
+    elif coordinator is not None and pc > 1:
+        if commit_timeout < peer_timeout:
+            log(f"[resilience] WARNING: --commit_timeout_s "
+                f"{commit_timeout:.0f} is below --peer_timeout_s "
+                f"{peer_timeout:.0f} — the commit barrier gives up on a "
+                f"slow-but-live peer before the watchdog could even call "
+                f"it dead (inverted ordering: expect spurious counted "
+                f"save_failures)")
+        if readmit_timeout > 0 and sc > 1 \
+                and commit_timeout > readmit_timeout:
+            log(f"[resilience] WARNING: --commit_timeout_s "
+                f"{commit_timeout:.0f} exceeds --readmit_timeout_s "
+                f"{readmit_timeout:.0f} — a survivor draining a stuck "
+                f"commit barrier can outlive the re-admission hold "
+                f"window and degrade every slice recovery into a "
+                f"pod_fallback_restart")
     manager = None
     if cadence:
-        sim_kw = {}
+        sim_kw = {"commit_timeout_s": commit_timeout}
         if simulated and pc > 1:
             # simulated pod: complementary shard owners (the r9 test
             # seam — host 0 writes the full replica-0 cover, peers write
             # empty shard sets whose DONE markers the commit barrier
             # still requires) + the fs-based restore step agreement
-            sim_kw = dict(
+            sim_kw.update(
                 process_index=pi, process_count=pc,
-                shard_owner=((lambda sh: sh.replica_id == 0) if pi == 0
-                             else (lambda sh: False)),
-                # a host missing the commit barrier longer than the peer
-                # timeout is presumed dead — keep the two timescales tied
-                commit_timeout_s=max(
-                    2.0 * float(getattr(cfg, "peer_timeout_s", 60.0)),
-                    10.0))
+                shard_owner=_sim_shard_owner(pi))
         if coordinator is not None and (simulated or sc > 1) and pc > 1:
             # marker-transport restore agreement: fs-simulated pods (jax
             # single-process per host), and REAL multi-slice pods — a
@@ -219,4 +281,4 @@ def build_resilience(cfg, log: Callable[[str], None] = print
                       faults=faults, goodput=goodput,
                       coordinator=coordinator, pod_index=pi, pod_count=pc,
                       pod_simulated=simulated, slice_index=si,
-                      slice_count=sc, backend=backend)
+                      slice_count=sc, backend=backend, spare_index=spare)

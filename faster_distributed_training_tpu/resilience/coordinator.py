@@ -75,7 +75,22 @@ the ``RJREADY`` readiness barrier; every host then advances the
 generation in place and resumes.  Whole-pod restart remains the
 fallback for every ambiguous corner: hold/rejoin timeout, a second
 failure outside the incident slice, or rejoin-retry residue (the
-durable ``RJ_ABORT`` marker degrades everyone to the r10 protocol)."""
+durable ``RJ_ABORT`` marker degrades everyone to the r10 protocol).
+
+r17 — warm spares: a STANDBY process (``FDT_SLICE_SPARE=<id>`` /
+``--warm_spares N``, :func:`spare_identity`) parks outside the pod —
+mesh built, programs warmed through the persistent executable cache,
+params restored to the last COMMIT and refreshed at each new one —
+and, when an incident confined to one slice parks the survivors in
+their hold, CLAIMS a failed seat with a durable first-writer-wins
+``CLAIM`` marker (:meth:`PodCoordinator.spare_wait`) and swaps in
+through the EXISTING rejoin machinery under the adopted member
+identity: the survivors' ``_await_readmission`` never learns the
+difference — it sees the seat's RJRENTER/RJRESTORE/RJREADY markers
+as always.  A relaunch of the original host finds the CLAIM and
+raises :class:`SeatTaken` (redundant by protocol, not restartable);
+every post-claim ambiguity degrades through ``RJ_ABORT`` to the
+whole-pod fallback like any rejoin."""
 
 from __future__ import annotations
 
@@ -94,6 +109,7 @@ ENV_POD_INDEX = "FDT_POD_INDEX"
 ENV_POD_COUNT = "FDT_POD_COUNT"
 ENV_SLICE_INDEX = "FDT_SLICE_INDEX"
 ENV_SLICE_COUNT = "FDT_SLICE_COUNT"
+ENV_SLICE_SPARE = "FDT_SLICE_SPARE"
 
 _GEN_DIR = re.compile(r"^gen_(?P<gen>\d{6})$")
 # strict: the atomic writer stages `FAIL_<pi>.tmp<pid>` beside the real
@@ -114,6 +130,16 @@ class PeerFailure(RuntimeError):
     supervisor together.  RESTARTABLE: the supervisor retries it like
     any crash (the next attempt converges on the same new generation on
     every host)."""
+
+
+class SeatTaken(RuntimeError):
+    """This host's pod seat was claimed by a warm spare while the host
+    was down (durable first-writer-wins ``CLAIM`` marker, r17): the
+    spare IS the seat now, so this relaunch is redundant by protocol.
+    NOT restartable — retrying can never win the seat back; the
+    supervisor re-raises it immediately (a platform that auto-relaunches
+    should treat the exit as terminal for this incident, or re-launch
+    the process as a fresh spare: FDT_SLICE_SPARE)."""
 
 
 class StepTimeout(RuntimeError):
@@ -173,6 +199,34 @@ def slice_identity(env=os.environ, process_index: Optional[int] = None,
     return (int(process_index) * sc // max(int(process_count), 1), sc, True)
 
 
+def spare_identity(env=os.environ) -> Optional[int]:
+    """The warm-spare seam beside :func:`pod_identity` (r17):
+    ``FDT_SLICE_SPARE=<id>`` marks this process a STANDBY spare — not
+    one of the pod's ``process_count`` members, but a pre-admitted
+    stand-in that parks (mesh built, programs warmed through the
+    executable cache, params restored to the last COMMIT) and claims a
+    failed slice's seat at re-admission time.  None = a normal member.
+    ``--warm_spares N`` is the launcher-side contract: spawn N extra
+    processes each carrying a distinct FDT_SLICE_SPARE id AND an
+    out-of-pod ``FDT_POD_INDEX`` (``pod_count + id`` by convention —
+    build_resilience derives that index regardless, but the telemetry
+    recorder reads the env directly and its host JSONL file must not
+    collide with a member's)."""
+    raw = env.get(ENV_SLICE_SPARE)
+    if raw in (None, ""):
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        # fail FAST: two spares launched with malformed ids that both
+        # silently mapped to 0 would share the synthetic pod index —
+        # exactly the marker/shard/telemetry collision the out-of-pod
+        # index exists to rule out
+        raise ValueError(
+            f"malformed {ENV_SLICE_SPARE}={raw!r}: want an integer "
+            f"spare id (each spare process needs a DISTINCT one)")
+
+
 def _write_json_atomic(path: str, obj) -> None:
     """Atomic marker write on the POSIX default backend — kept as a
     module-level helper for tests that plant markers directly; the
@@ -212,7 +266,8 @@ class PodCoordinator:
                  slice_index: Optional[int] = None,
                  slice_count: Optional[int] = None,
                  readmit_timeout_s: float = 0.0,
-                 backend: Optional[storage_mod.StorageBackend] = None):
+                 backend: Optional[storage_mod.StorageBackend] = None,
+                 spare_index: Optional[int] = None):
         if process_index is None or process_count is None:
             pi, pc, _sim = pod_identity()
             process_index = pi if process_index is None else process_index
@@ -232,6 +287,18 @@ class PodCoordinator:
         self.si = int(slice_index)
         self.sc = max(int(slice_count), 1)
         self.readmit_timeout_s = float(readmit_timeout_s)
+        # warm-spare identity (r17): a spare is NOT one of the pod's pc
+        # members — it parks under a synthetic out-of-pod index (pc +
+        # spare id, so its markers can never collide with a member's)
+        # until _spare_try_claim wins a failed seat and _adopt_seat
+        # re-keys pi/si to the claimed member identity
+        if spare_index is None:
+            spare_index = spare_identity()
+        self.spare_index = spare_index
+        if spare_index is not None:
+            self.pi = self.pc + int(spare_index)
+        self._claimed: Optional[Tuple[int, int]] = None  # (gen, seat)
+        self._spare_swap_t0: Optional[float] = None
         # every marker read/write/list routes through the storage
         # backend — with per-slice filesystems the backend (an object
         # store, or its tier-1 fake) IS what makes the `_pod/gen_<g>/`
@@ -366,6 +433,25 @@ class PodCoordinator:
             fails = self._failures(d)
             if all(self.slice_of(p) == self.si for p in fails):
                 mine = os.path.join(d, self._marker_name("RJRENTER", self.pi))
+                # r17 warm spares: the seat is arbitrated through ONE
+                # atomic point — the same first-writer-wins CLAIM
+                # create_if_absent a spare uses.  A check-then-proceed
+                # here would race a spare's claim in the gap between
+                # this relaunch's begin_attempt and its first durable
+                # rejoin marker (both processes would then drive the
+                # seat's barriers under one identity), so the ORIGINAL
+                # claims its own seat too; losing means a spare owns it.
+                if not self._claim_own_seat(d, gen):
+                    claim = os.path.join(
+                        d, self._marker_name("CLAIM", self.pi))
+                    got = self.backend.read_json(claim) or {}
+                    raise SeatTaken(
+                        f"pod seat {self.pi} (slice {self.si}) was "
+                        f"claimed by warm spare "
+                        f"{got.get('spare', '?')} in generation {gen} — "
+                        f"the spare swapped in for this incident and "
+                        f"this relaunch is redundant (re-launch with "
+                        f"FDT_SLICE_SPARE to park as the new spare)")
                 if self.backend.exists(os.path.join(d, _RJ_ABORT)):
                     pass          # a slice member already aborted rejoin
                 elif self.backend.exists(mine):
@@ -406,6 +492,40 @@ class PodCoordinator:
         self._ensure_thread()
         self._prune_generations()
         return g
+
+    def _claim_own_seat(self, gen_dir: str, gen: int) -> bool:
+        """The relaunched ORIGINAL's side of seat arbitration (r17):
+        claim our own seat through the same first-writer-wins
+        ``create_if_absent`` a spare uses — winning (or finding our own
+        previous claim: a rejoin retry, or the spare re-entering
+        begin_attempt post-adoption) means the seat is ours; losing to
+        a spare's claim means standing down (SeatTaken at the caller).
+        An unreadable existing claim is treated as spare-owned: with
+        the seat's ownership ambiguous, a redundant stand-down is safe
+        and a double identity is not."""
+        if self._claimed == (gen, self.pi):
+            return True          # the adopted spare re-entering
+        import json
+        key = os.path.join(gen_dir, self._marker_name("CLAIM", self.pi))
+        try:
+            won = self.backend.create_if_absent(
+                key, json.dumps({"pi": self.pi, "spare": None,
+                                 "unix_time": round(time.time(), 3)}
+                                ).encode("utf-8"))
+        except OSError:
+            return False         # can't arbitrate -> don't take the seat
+        if won:
+            self._claimed = (gen, self.pi)
+            return True
+        got = self.backend.read_json(key)
+        if got is not None and got.get("spare") is None \
+                and got.get("pi") == self.pi:
+            # our OWN earlier claim (a previous rejoin attempt of this
+            # same relaunched host) — the seat is still ours; the
+            # RJRENTER-residue check below decides retry vs RJ_ABORT
+            self._claimed = (gen, self.pi)
+            return True
+        return False
 
     def record_failure(self, exc: BaseException,
                        step: Optional[int] = None) -> None:
@@ -840,6 +960,19 @@ class PodCoordinator:
         self._align_target = int(target)
         if self._goodput is not None:
             self._goodput.count("slice_readmissions")
+        if self._spare_swap_t0 is not None:
+            # r17: this host is a warm spare completing its first
+            # release after claiming a seat — the claim→release wall
+            # time IS the swap (restore + catch-up + readiness barrier;
+            # programs were warmed while parked), the number the
+            # warm_spare_swap_s bench arm commits.  Tracked beside the
+            # badput segments, not among them: the window contains the
+            # restore segment and productive catch-up steps.
+            if self._goodput is not None:
+                self._goodput.add_warm_spare_swap(
+                    time.monotonic() - self._spare_swap_t0)
+                self._goodput.count("warm_spare_swaps")
+            self._spare_swap_t0 = None
         g = (self._gen or 0) + 1
         self._gen = g
         self._gen_dir = self._gen_path(g)
@@ -853,6 +986,146 @@ class PodCoordinator:
         self._write_heartbeat()
         self._log(f"[pod] host {self.pi}: slice re-admission complete at "
                   f"step {target}; advancing to generation {g} in place")
+
+    # -- warm spares (r17) -------------------------------------------------
+
+    def spare_wait(self, refresh_fn: Optional[Callable[[], None]] = None,
+                   stop_fn: Optional[Callable[[], bool]] = None,
+                   poll_s: float = 0.1) -> Optional[dict]:
+        """Park this STANDBY process (``spare_index`` armed) until a
+        seat is claimable: heartbeat at the coordination-dir root
+        (``SPAREHB_<id>`` — never parsed as a member heartbeat), call
+        ``refresh_fn`` each poll (the caller's "re-restore params at
+        each new COMMIT" hook — an optimization, never fatal), and scan
+        the NEWEST generation for an incident confined to one slice
+        whose survivors have all published HOLD.  Returns the claim
+        dict after :meth:`_adopt_seat`, or None when the pod completed
+        (every member's time-scoped EXIT marker present) or ``stop_fn``
+        fired.
+
+        Claiming waits for the COMPLETE survivor HOLD set first: holds
+        prove the survivors drained their in-flight saves and committed
+        to re-admission — claiming earlier would race the whole-pod
+        restart path on an incident the survivors may classify
+        differently.  Every ambiguous corner after the claim (missing
+        co-spares for a multi-seat slice, survivor failure, timeout)
+        rides the existing rejoin machinery and degrades to the durable
+        ``RJ_ABORT`` whole-pod fallback."""
+        if self.spare_index is None:
+            raise RuntimeError("spare_wait on a non-spare coordinator")
+        last_hb = 0.0
+        while True:
+            if stop_fn is not None and stop_fn():
+                return None
+            now = time.time()
+            if now - last_hb >= self.hb_interval_s:
+                try:
+                    self.backend.put_json(
+                        os.path.join(self.directory,
+                                     f"SPAREHB_{self.spare_index:03d}"),
+                        {"unix_time": round(now, 3)})
+                except OSError:
+                    pass
+                last_hb = now
+            if refresh_fn is not None:
+                try:
+                    refresh_fn()
+                except Exception as e:
+                    self._log(f"[spare] refresh failed ({e!r}); the swap "
+                              f"will restore cold instead")
+            done = 0
+            for p in range(self.pc):
+                got = self.backend.read_json(
+                    os.path.join(self.directory,
+                                 self._marker_name("EXIT", p)))
+                # time-scoped like _exited_peers (previous-run residue in
+                # a reused dir must not send a fresh spare home), with a
+                # 10 ms tolerance: EXIT times are written rounded to the
+                # millisecond, so a completion landing in the same
+                # millisecond this coordinator was created could round
+                # BELOW _created_t and park the spare forever — the
+                # residue gap the scoping guards against is run-LENGTH,
+                # not milliseconds
+                if got is not None and got.get(
+                        "unix_time", 0.0) > self._created_t - 0.01:
+                    done += 1
+            if done == self.pc:
+                self._log(f"[spare] spare {self.spare_index}: pod "
+                          f"completed without an incident; standing down")
+                return None
+            claim = self._spare_try_claim()
+            if claim is not None:
+                return claim
+            time.sleep(poll_s)
+
+    def _spare_try_claim(self) -> Optional[dict]:
+        gens = self._generations()
+        if not gens:
+            return None
+        gen, d = gens[-1]       # only the newest generation can hold a
+        #                         live incident — a released or restarted
+        #                         pod has already created a newer one
+        fails = self._failures(d)
+        if not fails or self.backend.exists(os.path.join(d, _RJ_ABORT)):
+            return None
+        failed_slices = {self.slice_of(p) for p in fails}
+        if len(failed_slices) != 1:
+            return None         # multi-slice incident: whole-pod territory
+        si = failed_slices.pop()
+        members = self._slice_members(si)
+        survivors = [p for p in range(self.pc) if self.slice_of(p) != si]
+        if not survivors:
+            return None         # a whole-pod death has nothing to hold
+        for p in survivors:
+            if self.backend.read_json(self._marker("HOLD", p, d)) is None:
+                return None     # survivors not (yet) parked for re-admission
+        import json
+        for p in members:
+            if self.backend.exists(
+                    os.path.join(d, self._marker_name("RJRENTER", p))):
+                # the real slice is already rejoining this seat —
+                # stand down rather than race it
+                return None
+            key = os.path.join(d, self._marker_name("CLAIM", p))
+            try:
+                won = self.backend.create_if_absent(
+                    key, json.dumps({"pi": p, "spare": self.spare_index,
+                                     "unix_time": round(time.time(), 3)}
+                                    ).encode("utf-8"))
+            except OSError:
+                return None
+            if won:
+                self._adopt_seat(p, si, gen, d)
+                return {"seat": p, "slice": si, "generation": gen}
+        return None             # every seat already claimed by other spares
+
+    def _adopt_seat(self, seat: int, si: int, gen: int,
+                    gen_dir: str) -> None:
+        """The spare becomes pod process ``seat``: pi/si re-key to the
+        claimed member identity, the coordinator enters the incident's
+        generation in REJOIN mode (the same machinery a relaunched
+        slice uses — restore through the slice-scoped barrier, catch up
+        to the survivors' agreed step, join RJREADY), and member
+        heartbeats start under the adopted name so the pod sees the
+        seat alive again."""
+        self._log(f"[spare] spare {self.spare_index} CLAIMED seat {seat} "
+                  f"(slice {si}, generation {gen}); swapping in")
+        self.pi = int(seat)
+        self.si = int(si)
+        self._gen = int(gen)
+        self._gen_dir = gen_dir
+        self._claimed = (int(gen), int(seat))
+        self._rejoining = True
+        self._rejoin_target = None
+        self._spare_swap_t0 = time.monotonic()
+        if self._goodput is not None:
+            self._goodput.count("warm_spare_claims")
+        self._attempt_wall_t = time.time()
+        self._last_polled = -1
+        self._escalated = False
+        self._progress_t = time.monotonic()
+        self._write_heartbeat()
+        self._ensure_thread()
 
     # -- restore step agreement (fs-simulated pods) ------------------------
 

@@ -33,11 +33,13 @@ _SEGMENTS = ("checkpoint_blocking_s", "emergency_save_s", "restore_s",
 # pod-coordinated restarts, resilience/coordinator.py;
 # slice_readmissions / pod_fallback_restarts: r14 slice-granular
 # recovery — completed re-admissions vs holds/rejoins that degraded to
-# the whole-pod protocol)
+# the whole-pod protocol; warm_spare_claims / warm_spare_swaps: r17
+# warm-spare slices — seats claimed vs swaps completed through release)
 _COUNTERS = ("saves", "skipped_saves", "save_failures", "shard_writes",
              "restores", "restarts", "preemptions", "steps",
              "peer_failures", "step_timeouts", "restart_generations",
-             "slice_readmissions", "pod_fallback_restarts")
+             "slice_readmissions", "pod_fallback_restarts",
+             "warm_spare_claims", "warm_spare_swaps")
 
 
 class GoodputTracker:
@@ -54,6 +56,23 @@ class GoodputTracker:
         # resume start) is not recovery work — snapshotted when the
         # first restart lands so the MTTR numerator excludes it
         self._restore_pre_restart: Optional[float] = None
+        # program-acquisition (trace + compile-or-deserialize) seconds,
+        # fed by the compile observatory (telemetry/programs.py) when
+        # wired.  Tracked BESIDE the badput segments, not among them:
+        # reclassifying compile as badput would shift every run's
+        # goodput_pct — this exists to SPLIT restart MTTR into its
+        # compile vs restore components (the ROADMAP "compile-dominated
+        # on real hardware" half that restore_s alone can't see), so
+        # only the post-restart share enters the MTTR numerator, same
+        # pre/post-restart snapshot idiom as restore_s.
+        self._compile_s = 0.0
+        self._compile_pre_restart: Optional[float] = None
+        # warm-spare swap wall time (claim -> release), also tracked
+        # BESIDE the segments rather than among them: the swap window
+        # CONTAINS a restore (already a badput segment) and the
+        # catch-up training steps — counting it as a segment too would
+        # double-bill badput and understate the spare's goodput_pct
+        self._swap_s = 0.0
         # optional (counter, total) feed — the telemetry recorder
         # installs itself here (r12) so restarts/preemptions/peer
         # failures land in the run's JSONL stream AS THEY HAPPEN, not
@@ -77,12 +96,24 @@ class GoodputTracker:
                            f"want one of {_SEGMENTS}")
         self._seg[segment] += float(seconds)
 
+    def add_compile(self, seconds: float) -> None:
+        """Program-acquisition seconds (compile OR cache deserialize) —
+        the observatory's feed for the restart-MTTR compile split."""
+        self._compile_s += float(seconds)
+
+    def add_warm_spare_swap(self, seconds: float) -> None:
+        """Warm-spare swap wall time (coordinator claim -> release) —
+        published in the summary, never summed into badput (the window
+        overlaps the restore segment and productive catch-up steps)."""
+        self._swap_s += float(seconds)
+
     def count(self, counter: str, n: int = 1) -> None:
         if counter not in self._cnt:
             raise KeyError(f"unknown counter {counter!r}; "
                            f"want one of {_COUNTERS}")
         if counter == "restarts" and self._restore_pre_restart is None:
             self._restore_pre_restart = self._seg["restore_s"]
+            self._compile_pre_restart = self._compile_s
         self._cnt[counter] += n
         if self._event_sink is not None and counter != "steps":
             try:
@@ -114,22 +145,36 @@ class GoodputTracker:
         for k, v in self._seg.items():
             out[k] = round(v, 3)
         out.update(self._cnt)
+        out["compile_s"] = round(self._compile_s, 3)
+        out["warm_spare_swap_s"] = round(self._swap_s, 3)
         if self._cnt["steps"]:
             out["productive_step_ms"] = round(
                 productive / self._cnt["steps"] * 1e3, 3)
         if self._cnt["restarts"]:
             # mean time-to-recover per restart: detection latency (peer
             # marker/staleness observation) + supervisor backoff +
-            # checkpoint restore — the r10 MTTR headline the
-            # restart_mttr_s bench arm tracks.  Rollback replay cost is
-            # deliberately separate (rollback_lost_s): it scales with
-            # checkpoint cadence, not with recovery machinery.  Only
-            # restore time spent AFTER the first restart counts — the
-            # restore a resumed run starts from is startup, not
-            # recovery, and would otherwise inflate the headline.
+            # checkpoint restore + program re-acquisition (recompile or
+            # cache deserialize — r17: the compile-dominated component
+            # real-hardware MTTR was blind to), with the compile and
+            # restore halves published as restart_mttr_compile_s /
+            # restart_mttr_restore_s so the executable cache's win is a
+            # readable split.  Rollback replay cost is deliberately
+            # separate (rollback_lost_s): it scales with checkpoint
+            # cadence, not with recovery machinery.  Only restore/
+            # compile time spent AFTER the first restart counts — the
+            # restore (and first-compile) a resumed run starts from is
+            # startup, not recovery, and would otherwise inflate the
+            # headline.
+            restarts = self._cnt["restarts"]
             recovery_restore = (self._seg["restore_s"]
                                 - (self._restore_pre_restart or 0.0))
+            recovery_compile = (self._compile_s
+                                - (self._compile_pre_restart or 0.0))
+            out["restart_mttr_restore_s"] = round(
+                recovery_restore / restarts, 3)
+            out["restart_mttr_compile_s"] = round(
+                recovery_compile / restarts, 3)
             out["restart_mttr_s"] = round(
                 (self._seg["detect_s"] + self._seg["restart_backoff_s"]
-                 + recovery_restore) / self._cnt["restarts"], 3)
+                 + recovery_restore + recovery_compile) / restarts, 3)
         return out

@@ -145,6 +145,7 @@ class AsyncCheckpointManager:
         self.keep = max(int(keep), 1)
         self.async_save = bool(async_save)
         self._goodput = goodput
+        self._base_log = log
         self._log = log if self._pi == 0 else (lambda *_: None)
         self._last_save_t = time.monotonic()
         self._last_save_step: Optional[int] = None
@@ -388,6 +389,18 @@ class AsyncCheckpointManager:
                 pass
             self._finalize_inflight()
 
+    def adopt_identity(self, process_index: int,
+                       shard_owner: Optional[Callable] = None) -> None:
+        """Re-key this manager to an adopted pod seat (r17 warm spares):
+        a spare parks under a synthetic out-of-pod index (it must never
+        commit, prune, or sweep while the real pod runs) and, after
+        claiming a failed member's seat, takes over that member's shard
+        ownership, commit-barrier role, and log gating."""
+        self._pi = int(process_index)
+        if shard_owner is not None:
+            self._shard_owner = shard_owner
+        self._log = self._base_log if self._pi == 0 else (lambda *_: None)
+
     def wait(self) -> None:
         """Block until no save is in flight (tests / epoch boundaries)."""
         self._drain_inflight()
@@ -433,6 +446,37 @@ class AsyncCheckpointManager:
         see :meth:`_restore_latest_impl` for the semantics."""
         with spans.span("restore"):
             return self._restore_latest_impl(state)
+
+    def peek_latest(self, state) -> Optional[Tuple[Any, dict]]:
+        """Barrier-free READ-ONLY restore of the newest committed
+        checkpoint — the warm-spare refresh path (r17).  A parked spare
+        is OUTSIDE the pod's restore protocol: it must neither join the
+        members' rendezvous/agreement barriers (it would wedge them)
+        nor sweep uncommitted residue (restore_latest's deletion point
+        is only race-free because the peers are blocked in the
+        agreement collective — a spare has no such guarantee).  Walks
+        newest-first past corrupt-but-committed entries exactly like
+        restore_latest; returns (state, meta) or None.  Does NOT touch
+        cadence anchors or goodput (a refresh is not recovery)."""
+        for step, name in reversed(self._entries()):
+            path = os.path.join(self.directory, name)
+            if not ckpt.is_committed(path, backend=self.backend):
+                continue
+            try:
+                if ckpt.is_sharded_checkpoint(path, backend=self.backend):
+                    restored, _e, _b = ckpt.restore_sharded_checkpoint(
+                        self.directory, name, state, backend=self.backend)
+                else:
+                    restored, _e, _b = ckpt.restore_checkpoint(
+                        self.directory, name, state)
+                meta = ckpt.read_checkpoint_meta(self.directory, name,
+                                                 backend=self.backend)
+                return restored, meta
+            except Exception as e:
+                self._base_log(f"[ckpt] peek: checkpoint {name} is "
+                               f"committed but failed to restore ({e!r}); "
+                               f"trying the previous one")
+        return None
 
     def _restore_latest_impl(self, state) -> Optional[Tuple[Any, dict]]:
         """(restored_state, meta) from the newest checkpoint that BOTH

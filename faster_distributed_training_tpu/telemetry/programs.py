@@ -52,6 +52,18 @@ Kill switch: ``FDT_PROGRAM_OBS=0`` — the Trainer falls back to plain
 ``jax.jit`` dispatch (byte-identical programs, no program events).
 ``FDT_HLO_FINGERPRINT=0`` skips the ``as_text()`` hash for very large
 programs (the rest of the record is unaffected).
+
+r17 instant restart: when a
+:class:`~faster_distributed_training_tpu.resilience.executable_cache
+.ExecutableCache` is installed on the observatory, observe_compile
+becomes lookup-before-compile / store-after-compile and every program
+record carries a ``cache_source`` verdict —  ``"deserialized"`` (the
+executable tier served it; compile_ms is the deserialize time),
+``"persistent_dir"`` (XLA's persistent cache dir served the compile),
+or ``"compiled"`` (full price paid, and the executable tier stored it
+for the next restart).  ``summary()``'s ``total_compile_ms`` therefore
+reads as the run's total program-ACQUISITION cost either way, which is
+exactly the restart-MTTR compile component.
 """
 
 from __future__ import annotations
@@ -175,6 +187,20 @@ class ProgramObservatory:
         self.programs: Dict[str, List[dict]] = {}
         self.retraces: List[dict] = []
         self._variant_flood_warned: set = set()
+        # r17 instant-restart wiring, both installed post-construction
+        # by cli.run_training:
+        #  * executable_cache (resilience/executable_cache.py) turns
+        #    observe_compile into lookup-before-compile /
+        #    store-after-compile — a restarted process deserializes its
+        #    programs (cache_source="deserialized") instead of
+        #    recompiling; any cache failure degrades to plain compile;
+        #  * goodput (resilience/goodput.py) receives every observed
+        #    program-acquisition cost (lower + compile OR deserialize)
+        #    so restart MTTR can split into _compile_s vs _restore_s
+        #    components — the compile-dominated half was invisible
+        #    before.
+        self.executable_cache = None
+        self.goodput = None
 
     # -- the compile path --------------------------------------------------
 
@@ -193,23 +219,63 @@ class ProgramObservatory:
             lowered = jitted.lower(*args)
             lower_ms = (time.monotonic() - t0) * 1e3
             fingerprint = self._fingerprint(lowered)
-            before = self._cache_listing()
-            t0 = time.monotonic()
-            compiled = lowered.compile()
-            compile_ms = (time.monotonic() - t0) * 1e3
-            cache, method = self._cache_verdict(before, compile_ms)
+            # r17 executable cache: lookup-before-compile.  A hit
+            # deserializes the stored executable (compile_ms below IS
+            # the deserialize time — the restart-MTTR number the A/B
+            # reads); any load failure returned None and the plain
+            # compile below serves the program.  Fingerprint "" (the
+            # FDT_HLO_FINGERPRINT=0 escape) has no key and skips the
+            # tier entirely.
+            ec = self.executable_cache
+            exec_key = (ec.key_for(name, fingerprint)
+                        if ec is not None and fingerprint else None)
+            compiled = None
+            if exec_key is not None:
+                t0 = time.monotonic()
+                compiled = ec.load(exec_key, lowered)
+            if compiled is not None:
+                compile_ms = (time.monotonic() - t0) * 1e3
+                cache, method = "bypassed", "executable_cache"
+                source = "deserialized"
+            else:
+                before = self._cache_listing()
+                t0 = time.monotonic()
+                compiled = lowered.compile()
+                compile_ms = (time.monotonic() - t0) * 1e3
+                cache, method = self._cache_verdict(before, compile_ms)
+                # "persistent_dir": XLA's own persistent cache served
+                # the compile (the executable tier's designed fallback)
+                source = "persistent_dir" if cache == "hit" else "compiled"
+                if exec_key is not None:
+                    if cache in ("miss", "off", "below_threshold"):
+                        ec.store(exec_key, compiled)  # best-effort, counted
+                    else:
+                        # served (or unverifiable, remote-dir "unknown"):
+                        # a persistent-cache-served executable does NOT
+                        # serialize round-trippably on XLA:CPU (missing
+                        # function symbols at deserialize) — only fresh
+                        # compiles are stored; the persistent dir keeps
+                        # serving this program at restart regardless
+                        ec.note_skipped_served()
             mem = memory_analysis_dict(compiled)
         except Exception as e:
             self._log(f"[programs] could not observe-compile {name!r} "
                       f"({e!r}); plain jit dispatch serves it (no program "
                       f"record)")
             return None
+        if self.goodput is not None:
+            # program-acquisition cost (trace + compile-or-deserialize):
+            # the MTTR compile component a restarted process pays
+            try:
+                self.goodput.add_compile((lower_ms + compile_ms) / 1e3)
+            except Exception:
+                pass  # accounting must never kill the compile path
         self._record(name, sig, lower_ms, compile_ms, fingerprint, cache,
-                     method, mem)
+                     method, mem, source)
         return compiled
 
     def _record(self, name, sig, lower_ms, compile_ms, fingerprint,
-                cache, method, mem) -> None:
+                cache, method, mem, source: str = "compiled") -> None:
         with self._lock:
             entries = self.programs.setdefault(name, [])
             self._detect_retrace(name, entries, sig)
@@ -218,6 +284,7 @@ class ProgramObservatory:
                      "lower_ms": round(lower_ms, 2),
                      "fingerprint": fingerprint,
                      "cache": cache, "cache_method": method,
+                     "cache_source": source,
                      "avals": _sig_text(sig) if sig else "",
                      "_sig": sig}
             if mem:
@@ -231,6 +298,7 @@ class ProgramObservatory:
                   "fingerprint": entry["fingerprint"],
                   "cache": entry["cache"],
                   "cache_method": entry["cache_method"],
+                  "cache_source": entry["cache_source"],
                   "avals": entry["avals"]}
             if mem:
                 ev.update(mem)

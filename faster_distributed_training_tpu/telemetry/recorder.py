@@ -70,6 +70,14 @@ by ``"kind"``:
   ``serve_request`` {bucket, len, queue_ms, total_ms, replica}
                  (one per fulfilled request; len is the raw
                   pre-truncation length)
+  ``spare``      {event, spare, seat, slice, generation, step}
+                 (r17 warm-spare lifecycle: parked / claimed — the
+                  swap duration rides the goodput stream as
+                  warm_spare_swap_s)
+
+r17 append-only field addition: ``program`` records grew
+``cache_source`` ({deserialized, persistent_dir, compiled} — which
+tier served the executable; resilience/executable_cache.py).
 
 The machine-checkable registry of the above is TELEMETRY_SCHEMA below;
 ``scripts/check_telemetry_schema.py`` AST-scans every emission site in
@@ -125,10 +133,14 @@ TELEMETRY_SCHEMA: Dict[str, Optional[frozenset]] = {
     "goodput_event": frozenset({"counter", "total"}),
     "rollback": frozenset({"epoch", "restored_epoch", "step"}),
     "flush_stats": frozenset({"dropped_records"}),
+    # cache_source (r17 instant restart, append-only): where the
+    # executable came from — "deserialized" (persistent executable
+    # cache, resilience/executable_cache.py), "persistent_dir" (XLA's
+    # compilation-cache dir served the compile), "compiled" (full price)
     "program": frozenset({"name", "variant", "lowerings", "compile_ms",
                           "lower_ms", "fingerprint", "cache",
-                          "cache_method", "avals", "argument_bytes",
-                          "output_bytes", "temp_bytes",
+                          "cache_method", "cache_source", "avals",
+                          "argument_bytes", "output_bytes", "temp_bytes",
                           "generated_code_bytes", "alias_bytes"}),
     "retrace": frozenset({"name", "reason", "lowerings", "avals",
                           "prev_avals"}),
@@ -146,6 +158,13 @@ TELEMETRY_SCHEMA: Dict[str, Optional[frozenset]] = {
                               "dispatch_ms", "attempts"}),
     "serve_request": frozenset({"bucket", "len", "queue_ms", "total_ms",
                                 "replica"}),
+    # r17 warm-spare slices (cli._run_warm_spare) — append-only: one
+    # record when a spare parks (event="parked") and one when it claims
+    # a failed seat (event="claimed", with the adopted seat/slice/
+    # generation); the swap duration itself lands in the goodput stream
+    # (warm_spare_swap_s)
+    "spare": frozenset({"event", "spare", "seat", "slice", "generation",
+                        "step"}),
 }
 # kinds that once existed but are no longer emitted (none today): the
 # lint's staleness rule consults this instead of forcing removal from

@@ -27,7 +27,12 @@ import sys
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(_HERE)
 
-# modules that must route every durable write through the backend
+# modules that must route every durable write through the backend.
+# The resilience/ entry is the whole package, so new modules are
+# covered the day they land — r17's executable_cache.py (whose entries
+# must be readable by a slice restarting on a DIFFERENT machine, the
+# object-store case exactly) is pinned in the scan set by
+# tests/test_executable_cache.py.
 SCANNED = (
     "faster_distributed_training_tpu/resilience",
     "faster_distributed_training_tpu/train/checkpoint.py",

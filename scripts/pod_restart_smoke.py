@@ -22,7 +22,16 @@ cross-PROCESS), with injected kills.  Three scenarios:
     rolls back), the killed slice restarts, REJOINS the same
     generation, catches up to the agreed step, and all four hosts
     finish digest-equal to the uninterrupted reference with
-    ``slice_readmissions`` counted and ``pod_fallback_restarts`` == 0.
+    ``slice_readmissions`` counted and ``pod_fallback_restarts`` == 0;
+  * ``--cache`` (r17 instant restart): crash + PROCESS-relaunch twins,
+    one with ``--executable_cache on`` and one cold, each against its
+    own hermetic XLA compilation-cache dir — the cached relaunch must
+    record ``cache_source=deserialized`` for EVERY steady-state
+    program (train + eval) with zero retraces, finish bitwise-equal to
+    the cold-restart twin AND the uninterrupted reference, and spend
+    less on program acquisition than the cold twin (the
+    ``restart_cached_mttr_s`` < ``restart_mttr_s`` story at smoke
+    scale).
 
 The default scenario additionally asserts the r15 crash flight
 recorder: the killed host's injected crash must leave a durable
@@ -33,6 +42,7 @@ storage backend the children used) that parses and names the fault —
     python scripts/pod_restart_smoke.py                      # CPU, ~1 min
     python scripts/pod_restart_smoke.py --backend fake_object_store
     python scripts/pod_restart_smoke.py --slices 2
+    python scripts/pod_restart_smoke.py --cache
     FDT_SMOKE_DIE_AT=9 python scripts/pod_restart_smoke.py
 
 Prints PASS/FAIL per assertion; exit code 0 iff all pass."""
@@ -105,12 +115,21 @@ if os.environ.get("FDT_POD_COUNT"):
     cfg = cfg.replace(supervise=True, checkpoint_every=%(every)d,
                       preempt_sync_every=1, peer_timeout_s=5.0,
                       max_restarts=3)
+if os.environ.get("FDT_SMOKE_CKPT_EVERY"):
+    # the --cache relaunch scenario: cadence saves without a pod
+    cfg = cfg.replace(
+        checkpoint_every=int(os.environ["FDT_SMOKE_CKPT_EVERY"]))
+if os.environ.get("FDT_SMOKE_EXEC_CACHE"):
+    cfg = cfg.replace(
+        executable_cache=os.environ["FDT_SMOKE_EXEC_CACHE"])
 out = run_training(cfg, log=lambda *a: print(*a, file=sys.stderr))
 print(json.dumps({
     "final_step": int(out["state"].step),
     "digest": mod.state_digest(out["state"]),
     "restarts": int(out.get("goodput_restarts", 0)),
     "restores": int(out.get("goodput_restores", 0)),
+    "restore_s": float(out.get("goodput_restore_s", 0.0)),
+    "compile_s": float(out.get("goodput_compile_s", 0.0)),
     "peer_failures": int(out.get("goodput_peer_failures", 0)),
     "restart_generations": int(out.get("goodput_restart_generations", 0)),
     "restart_mttr_s": float(out.get("goodput_restart_mttr_s", 0.0)),
@@ -127,13 +146,16 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _spawn(workdir: str, pod: bool, pi: int = 0, die_at: int = 0,
            backend: str = "posix", pod_count: int = 2, slices: int = 1,
-           die_slice: int = -1):
+           die_slice: int = -1, extra_env=None):
     env = dict(os.environ, FDT_SMOKE_DIR=workdir, FDT_SMOKE_REPO=_REPO,
                FDT_SMOKE_BACKEND=backend, JAX_PLATFORMS="cpu")
     for k in ("FDT_POD_INDEX", "FDT_POD_COUNT", "FDT_SLICE_COUNT",
               "FDT_FAULT_HOST", "FDT_FAULT_SLICE",
-              "FDT_FAULT_DIE_AT_STEP"):
+              "FDT_FAULT_DIE_AT_STEP", "FDT_SMOKE_CKPT_EVERY",
+              "FDT_SMOKE_EXEC_CACHE", "FDT_COMPILATION_CACHE"):
         env.pop(k, None)
+    if extra_env:
+        env.update(extra_env)
     if pod:
         env.update(FDT_POD_INDEX=str(pi), FDT_POD_COUNT=str(pod_count))
         if slices > 1:
@@ -153,8 +175,13 @@ def _spawn(workdir: str, pod: bool, pi: int = 0, die_at: int = 0,
                             stderr=subprocess.PIPE, text=True)
 
 
-def _join(proc, label: str) -> dict:
+def _join(proc, label: str, expect_fail: bool = False) -> dict:
     out, err = proc.communicate(timeout=900)
+    if expect_fail:
+        if proc.returncode == 0:
+            raise RuntimeError(f"{label} was expected to crash but "
+                               f"exited cleanly")
+        return {}
     if proc.returncode != 0:
         print(f"--- {label} stderr ---\n{err[-3000:]}", file=sys.stderr)
         raise RuntimeError(f"{label} exited rc={proc.returncode}")
@@ -171,7 +198,8 @@ def _reference_digest() -> str:
 
 
 def main(ref_digest: str = "", backend: str = "posix",
-         slices: int = 1) -> int:
+         slices: int = 1, cache: bool = False,
+         cache_cold_twin: bool = True) -> int:
     die_at = int(os.environ.get("FDT_SMOKE_DIE_AT", "6"))
     failures = 0
 
@@ -183,6 +211,12 @@ def main(ref_digest: str = "", backend: str = "posix",
 
     if not ref_digest:
         ref_digest = _reference_digest()
+
+    if cache:
+        failures += _run_cache_scenario(check, ref_digest,
+                                        cold_twin=cache_cold_twin)
+        print("PASS" if not failures else f"FAIL ({failures} assertion(s))")
+        return 1 if failures else 0
 
     if slices > 1:
         failures += _run_slice_scenario(check, ref_digest, backend, die_at)
@@ -278,6 +312,94 @@ def _inspection_backend(backend: str, workdir: str):
     return storage.build_backend(backend, workdir, log=lambda *_: None)
 
 
+def _run_cache_scenario(check, ref_digest: str,
+                        cold_twin: bool = True) -> int:
+    """r17 instant-restart acceptance: crash + process-relaunch twins,
+    cached (--executable_cache on) vs cold, each against a hermetic XLA
+    compilation-cache dir (a warm developer ~/.cache would serve the
+    crash phase's compiles, and XLA:CPU cache-served executables don't
+    serialize round-trippably — the scenario must measure the tier, not
+    the machine's history).  The kill lands in epoch 2 (step 13, after
+    the step-12 cadence save) so BOTH steady-state programs — the train
+    dispatch and the epoch-end eval — exist in the cache before the
+    relaunch.
+
+    ``cold_twin=False`` (the tier-1 wrapper's budget mode) runs only
+    the cached pair and checks its digest against the UNINTERRUPTED
+    reference — equivalent coverage, because cold-restart ≡
+    uninterrupted is already pinned bitwise by the resilience e2e
+    suite (kill-at-N resume, r7) — and leaves the cold-acquisition
+    A/B to the bench `restart_mttr_s` vs `restart_cached_mttr_s`
+    arms; the manual script run keeps the full twin."""
+    die_at = 13
+    runs = {}
+    for mode in (("cold", "cached") if cold_twin else ("cached",)):
+        workdir = tempfile.mkdtemp(prefix=f"fdt_cache_smoke_{mode}_")
+        env = {"FDT_SMOKE_CKPT_EVERY": "4"}
+        if mode == "cached":
+            env["FDT_SMOKE_EXEC_CACHE"] = "on"
+        print(f"phase {mode}: crash at step {die_at} + process relaunch "
+              f"(dir {workdir})")
+        # die_at rides extra_env: _spawn's die_at parameter is the POD
+        # scenarios' (it also arms FDT_FAULT_HOST); this is a plain
+        # single-process crash.  Each PHASE gets its own hermetic XLA
+        # compilation-cache dir: the persistent dir is MACHINE-LOCAL
+        # and a restarted slice on a fresh machine doesn't have it —
+        # only the executable cache (durable, StorageBackend) survives,
+        # which is exactly the tier the twins A/B.
+        _join(_spawn(workdir, pod=False,
+                     extra_env={**env,
+                                "FDT_COMPILATION_CACHE":
+                                    tempfile.mkdtemp(prefix="fdt_xla_"),
+                                "FDT_FAULT_DIE_AT_STEP": str(die_at)}),
+              f"{mode} crash", expect_fail=True)
+        runs[mode] = _join(
+            _spawn(workdir, pod=False,
+                   extra_env={**env, "FDT_COMPILATION_CACHE":
+                              tempfile.mkdtemp(prefix="fdt_xla_")}),
+            f"{mode} relaunch")
+        try:
+            with open(os.path.join(workdir, "telemetry",
+                                   "manifest.json")) as f:
+                runs[mode]["manifest"] = json.load(f)
+        except (OSError, ValueError):
+            runs[mode]["manifest"] = {}
+    cached = runs["cached"]
+    check("cached relaunch finished every step",
+          cached["final_step"] == TOTAL_STEPS, str(cached["final_step"]))
+    check("cached relaunch bitwise-equal to the (cold-restart ≡ "
+          "uninterrupted) reference",
+          cached["digest"] == ref_digest,
+          f"{cached['digest'][:12]} vs {ref_digest[:12]}")
+    progs = {p["name"]: [v.get("cache_source") for v in p["variants"]]
+             for p in cached["manifest"].get("compile", {})
+             .get("programs", [])}
+    steady = {n: s for n, s in progs.items()
+              if n.startswith("train:") or n == "eval"}
+    check("cached relaunch deserialized EVERY steady-state program",
+          bool(steady) and all(s == "deserialized"
+                               for srcs in steady.values() for s in srcs),
+          str(progs))
+    check("zero retraces in the cached relaunch",
+          cached["manifest"].get("compile", {}).get("retraces") == [],
+          str(cached["manifest"].get("compile", {}).get("retraces")))
+    check("cached relaunch actually restored a checkpoint",
+          cached["restores"] == 1, str(cached["restores"]))
+    if cold_twin:
+        cold = runs["cold"]
+        check("cold relaunch finished every step",
+              cold["final_step"] == TOTAL_STEPS, str(cold["final_step"]))
+        check("cached relaunch bitwise-equal to the cold-restart twin",
+              cached["digest"] == cold["digest"],
+              f"{cached['digest'][:12]} vs {cold['digest'][:12]}")
+        check("cached program acquisition cheaper than cold recompile",
+              0 < cached["compile_s"] < cold["compile_s"],
+              f"{cached['compile_s']:.2f}s vs {cold['compile_s']:.2f}s")
+        check("cold relaunch restored a checkpoint too",
+              cold["restores"] == 1, str(cold["restores"]))
+    return 0
+
+
 def _run_slice_scenario(check, ref_digest: str, backend: str,
                         die_at: int) -> int:
     """2-slice pod, 4 processes, slice 1 killed whole via
@@ -327,5 +449,9 @@ if __name__ == "__main__":
     ap.add_argument("--backend", default="posix",
                     choices=["posix", "fake_object_store"])
     ap.add_argument("--slices", type=int, default=1, choices=[1, 2])
+    ap.add_argument("--cache", action="store_true",
+                    help="r17 instant-restart scenario: crash + relaunch "
+                         "twins, executable cache vs cold recompile")
     args = ap.parse_args()
-    sys.exit(main(backend=args.backend, slices=args.slices))
+    sys.exit(main(backend=args.backend, slices=args.slices,
+                  cache=args.cache))

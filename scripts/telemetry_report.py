@@ -179,12 +179,16 @@ def render(report: dict) -> str:
                          f"mean={st['mean_ms']:>8.1f}ms")
     progs = report.get("programs")
     if progs:
-        lines.append("compiled programs (host 0; compile ms / cache / "
-                     "HLO fingerprint / temp bytes):")
+        lines.append("compiled programs (host 0; compile ms / source / "
+                     "cache / HLO fingerprint / temp bytes):")
         for p in progs:
             lines.append(
                 f"  {p.get('name', '?'):<24} "
                 f"compile={p.get('compile_ms', 0):>8.1f}ms "
+                # r17: which tier served the executable (deserialized =
+                # the persistent executable cache; compile_ms is then
+                # the deserialize time)
+                f"src={p.get('cache_source', '?'):<14} "
                 f"cache={p.get('cache', '?'):<15} "
                 f"hlo={p.get('fingerprint', '')[:12]:<12} "
                 f"temp={p.get('temp_bytes', 0) / 1e6:>8.1f}MB")

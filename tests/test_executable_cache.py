@@ -1,0 +1,320 @@
+"""Persistent executable cache tests (r17 tentpole,
+resilience/executable_cache.py + the observatory hook in
+telemetry/programs.py) — all CPU, tier-1.
+
+The contract under test: a restarted process DESERIALIZES its compiled
+programs instead of recompiling (cache_source="deserialized", bitwise-
+identical outputs — it is literally the same executable), any cache
+failure degrades to a plain compile (a corrupt entry must never block
+recovery), only FRESH compiles are stored (XLA:CPU executables served
+from the persistent compilation-cache dir do not serialize
+round-trippably — measured: "Symbols not found" at deserialize), and
+arming the cache zeroes the persistent-cache store floor so sub-second
+programs stop rotting as ``below_threshold`` (the r15 verdict trap)."""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from faster_distributed_training_tpu.resilience import executable_cache as ec
+from faster_distributed_training_tpu.resilience.goodput import GoodputTracker
+from faster_distributed_training_tpu.resilience.storage import (
+    FakeObjectStoreBackend)
+from faster_distributed_training_tpu.telemetry.programs import (
+    ProgramObservatory)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fn(x):
+    return jnp.tanh(x @ x) * 3.0
+
+
+def _observe(directory, name="train:t:k1", fn=_fn, backend=None,
+             goodput=None):
+    """One fresh observatory + cache + fresh jit: the unit of 'a new
+    process' for in-process tests (a fresh jax.jit re-lowers and would
+    recompile without the cache)."""
+    obs = ProgramObservatory(log=lambda *_: None)
+    obs.executable_cache = ec.ExecutableCache(directory, backend=backend,
+                                              log=lambda *_: None)
+    obs.goodput = goodput
+    wrapped = obs.wrap(name, jax.jit(fn), sig_argnums=(0,))
+    return obs, wrapped
+
+
+class TestExecutableCache:
+    def test_store_then_deserialize_bitwise(self, tmp_path):
+        x = jnp.ones((16, 16))
+        obs1, w1 = _observe(str(tmp_path))
+        out1 = w1(x)
+        e1 = obs1.programs["train:t:k1"][0]
+        assert e1["cache_source"] == "compiled"
+        assert obs1.executable_cache.stats["stores"] == 1
+        # "new process": fresh observatory, fresh jit, same cache dir
+        obs2, w2 = _observe(str(tmp_path))
+        out2 = w2(x)
+        e2 = obs2.programs["train:t:k1"][0]
+        assert e2["cache_source"] == "deserialized"
+        assert e2["cache"] == "bypassed"
+        assert e2["cache_method"] == "executable_cache"
+        assert obs2.executable_cache.stats == {
+            "hits": 1, "misses": 0, "stores": 0, "corrupt": 0,
+            "store_failures": 0, "skipped_served": 0}
+        # the same executable: outputs are bitwise-identical
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        # same HLO -> same key, same fingerprint across the "processes"
+        assert e1["fingerprint"] == e2["fingerprint"]
+        assert obs2.retraces == []
+
+    def test_corrupt_entry_degrades_to_compile_not_crash(self, tmp_path):
+        x = jnp.ones((16, 16))
+        obs1, w1 = _observe(str(tmp_path))
+        w1(x)
+        e1 = obs1.programs["train:t:k1"][0]
+        key = obs1.executable_cache.key_for("train:t:k1",
+                                            e1["fingerprint"])
+        with open(key, "wb") as f:
+            f.write(b"not an executable")
+        obs2, w2 = _observe(str(tmp_path))
+        out = w2(x)                      # must not raise
+        e2 = obs2.programs["train:t:k1"][0]
+        assert e2["cache_source"] == "compiled"   # fell back
+        assert obs2.executable_cache.stats["corrupt"] == 1
+        assert np.asarray(out).shape == (16, 16)
+        # ...and the fresh compile re-stored a good entry (self-heal)
+        assert obs2.executable_cache.stats["stores"] == 1
+        obs3, w3 = _observe(str(tmp_path))
+        w3(x)
+        assert obs3.programs["train:t:k1"][0]["cache_source"] == \
+            "deserialized"
+
+    def test_truncated_entry_fails_frame_check(self, tmp_path):
+        cache = ec.ExecutableCache(str(tmp_path), log=lambda *_: None)
+        key = os.path.join(str(tmp_path), "exec_x_abc")
+        with open(key, "wb") as f:
+            f.write(ec._MAGIC + (1000).to_bytes(8, "big") + b"short")
+        assert cache.load(key, None) is None      # never reaches jax
+        assert cache.stats["corrupt"] == 1
+
+    def test_environment_key_partitions_the_namespace(self, tmp_path):
+        a = ec.ExecutableCache(str(tmp_path), donate=True,
+                               log=lambda *_: None)
+        b = ec.ExecutableCache(str(tmp_path), donate=False,
+                               log=lambda *_: None)
+        assert a.env_key != b.env_key
+        assert a.key_for("p", "f" * 16) != b.key_for("p", "f" * 16)
+        # same environment -> same key (a restarted process finds it)
+        a2 = ec.ExecutableCache(str(tmp_path), donate=True,
+                                log=lambda *_: None)
+        assert a.key_for("p", "f" * 16) == a2.key_for("p", "f" * 16)
+
+    def test_object_store_backend_round_trip(self, tmp_path):
+        """The cache rides the r14 StorageBackend: a rename-free object
+        store serves it (the medium a cross-machine slice restart
+        actually reads through)."""
+        be = FakeObjectStoreBackend(root=str(tmp_path))
+        x = jnp.ones((16, 16))
+        _o1, w1 = _observe(str(tmp_path), backend=be)
+        w1(x)
+        obs2, w2 = _observe(str(tmp_path), backend=be)
+        w2(x)
+        assert obs2.programs["train:t:k1"][0]["cache_source"] == \
+            "deserialized"
+        assert be.counts["put"] >= 1 and be.counts["read"] >= 1
+
+    def test_goodput_billed_for_acquisition_both_ways(self, tmp_path):
+        """The observatory feeds program-acquisition seconds (compile OR
+        deserialize) to goodput — the restart_mttr_compile_s split."""
+        x = jnp.ones((16, 16))
+        g1 = GoodputTracker().start()
+        _obs, w1 = _observe(str(tmp_path), goodput=g1)
+        w1(x)
+        # the raw accumulator, not the 3-decimal summary rounding: a
+        # warm XLA jit cache can re-acquire this tiny program in <1 ms
+        assert g1._compile_s > 0
+        g2 = GoodputTracker().start()
+        _obs2, w2 = _observe(str(tmp_path), goodput=g2)
+        w2(x)
+        assert g2._compile_s > 0               # deserialize+trace billed
+
+    def test_served_compiles_are_not_stored(self, tmp_path, monkeypatch):
+        """Only FRESH compiles are stored: an executable served from
+        XLA's persistent cache dir serializes to a payload missing its
+        function symbols on this backend (measured), so storing it
+        would poison the next restart."""
+        obs = ProgramObservatory(log=lambda *_: None)
+        cache = ec.ExecutableCache(str(tmp_path), log=lambda *_: None)
+        obs.executable_cache = cache
+        monkeypatch.setattr(ProgramObservatory, "_cache_verdict",
+                            lambda self, before, ms: ("hit", "dir_stat"))
+        w = obs.wrap("train:t:k1", jax.jit(_fn), sig_argnums=(0,))
+        w(jnp.ones((16, 16)))
+        e = obs.programs["train:t:k1"][0]
+        assert e["cache_source"] == "persistent_dir"
+        assert cache.stats["stores"] == 0
+        assert cache.stats["skipped_served"] == 1
+
+
+class TestBuildAndVerdicts:
+    def _restore_cache_config(self):
+        return (getattr(jax.config, "jax_compilation_cache_dir", None),
+                getattr(jax.config,
+                        "jax_persistent_cache_min_compile_time_secs", 1.0))
+
+    def test_build_gating(self, tmp_path, monkeypatch):
+        from faster_distributed_training_tpu.config import TrainConfig
+        cfg_off = TrainConfig(checkpoint_dir=str(tmp_path))
+        assert ec.build_executable_cache(cfg_off,
+                                         log=lambda *_: None) is None
+        _d, min0 = self._restore_cache_config()
+        try:
+            cfg_on = cfg_off.replace(executable_cache="on")
+            c = ec.build_executable_cache(cfg_on, log=lambda *_: None)
+            assert c is not None
+            assert c.directory == os.path.join(str(tmp_path),
+                                               "_exec_cache")
+            # satellite pin (half 1): arming the cache zeroes the
+            # persistent-cache store floor so sub-second programs
+            # populate and hit the dir tier too
+            assert float(
+                jax.config.jax_persistent_cache_min_compile_time_secs
+            ) == 0.0
+            # the env kill switch beats the config flag
+            monkeypatch.setenv(ec.ENV_CACHE, "0")
+            assert ec.build_executable_cache(cfg_on,
+                                             log=lambda *_: None) is None
+            # ...and the env can force an explicit directory
+            monkeypatch.setenv(ec.ENV_CACHE, str(tmp_path / "elsewhere"))
+            c2 = ec.build_executable_cache(cfg_off, log=lambda *_: None)
+            assert c2 is not None
+            assert c2.directory == str(tmp_path / "elsewhere")
+        finally:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              min0)
+
+    def test_below_threshold_trap_fixed_with_zero_floor(self, tmp_path):
+        """Satellite pin (half 2, the r15 verdict trap): with the 1 s
+        default floor a sub-second program is never stored in the
+        persistent cache dir and every round reads ``below_threshold``;
+        with the floor zeroed (what arming the executable cache does)
+        the first compile stores ("miss") and a fresh jit of the same
+        HLO is served ("hit")."""
+        d0, min0 = self._restore_cache_config()
+
+        def _reset_xla_cache():
+            # jax holds the persistent cache as a process singleton
+            # bound to the dir at FIRST use: changing the config dir
+            # mid-process (this test, after a suite that already
+            # compiled) needs an explicit reset or entries keep landing
+            # in the old dir and the dir-stat verdict reads garbage
+            try:
+                from jax._src import compilation_cache as _cc
+                _cc.reset_cache()
+            except Exception:
+                pytest.skip("jax compilation cache not resettable here")
+
+        try:
+            jax.config.update("jax_compilation_cache_dir",
+                              str(tmp_path / "xla"))
+            os.makedirs(str(tmp_path / "xla"), exist_ok=True)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+            _reset_xla_cache()
+            obs = ProgramObservatory(log=lambda *_: None)
+            w = obs.wrap("t", jax.jit(_fn), sig_argnums=(0,))
+            w(jnp.ones((24, 24)))
+            first = obs.programs["t"][0]
+            assert first["cache"] == "miss"        # stored, not skipped
+            obs2 = ProgramObservatory(log=lambda *_: None)
+            w2 = obs2.wrap("t", jax.jit(_fn), sig_argnums=(0,))
+            w2(jnp.ones((24, 24)))
+            second = obs2.programs["t"][0]
+            assert second["cache"] == "hit"        # NOT below_threshold
+            assert second["cache_source"] == "persistent_dir"
+        finally:
+            jax.config.update("jax_compilation_cache_dir", d0 or "")
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              min0)
+            _reset_xla_cache()
+
+
+_CHILD = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["FDT_TEST_REPO"])
+import jax, jax.numpy as jnp
+from faster_distributed_training_tpu.resilience import executable_cache as ec
+from faster_distributed_training_tpu.telemetry.programs import (
+    ProgramObservatory)
+
+# named _fn like the parent's: the HLO module name embeds the jitted
+# function's __name__, so the fingerprint (correctly) keys on it
+def _fn(x):
+    return jnp.tanh(x @ x) * 3.0
+
+obs = ProgramObservatory(log=lambda *_: None)
+obs.executable_cache = ec.ExecutableCache(os.environ["FDT_TEST_CACHE"],
+                                          log=lambda *_: None)
+w = obs.wrap("train:t:k1", jax.jit(_fn), sig_argnums=(0,))
+w(jnp.ones((16, 16)))
+e = obs.programs["train:t:k1"][0]
+print(json.dumps({"cache_source": e["cache_source"],
+                  "lowerings": len(obs.programs["train:t:k1"]),
+                  "retraces": len(obs.retraces),
+                  "stats": obs.executable_cache.stats}))
+"""
+
+
+def test_cross_process_cache_reuse(tmp_path):
+    """ISSUE satellite: a CHILD PROCESS compiles through a
+    pre-populated StorageBackend cache dir and records
+    cache_source=deserialized with exactly one lowering and zero
+    retraces — the restart scenario for real (serialized bytes over
+    the filesystem, no shared interpreter state)."""
+    x = jnp.ones((16, 16))
+    obs, w = _observe(str(tmp_path))           # parent populates
+    w(x)
+    assert obs.executable_cache.stats["stores"] == 1
+    # the child must match the parent's numeric config (conftest sets
+    # x64/threefry via jax.config, invisible to subprocesses) or its
+    # HLO — and so its cache key — legitimately differs
+    env = dict(os.environ, FDT_TEST_REPO=_REPO,
+               FDT_TEST_CACHE=str(tmp_path), JAX_PLATFORMS="cpu",
+               JAX_ENABLE_X64=str(int(jax.config.jax_enable_x64)),
+               JAX_THREEFRY_PARTITIONABLE=str(
+                   int(jax.config.jax_threefry_partitionable)))
+    p = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, p.stderr[-2000:]
+    got = json.loads(p.stdout.strip().splitlines()[-1])
+    assert got["cache_source"] == "deserialized", got
+    assert got["lowerings"] == 1 and got["retraces"] == 0
+    assert got["stats"]["hits"] == 1 and got["stats"]["misses"] == 0
+
+
+def test_storage_routing_lint_covers_executable_cache():
+    """ISSUE satellite: the rename/rmtree ban
+    (scripts/check_storage_routing.py) extends to the new module — it
+    lives inside the scanned resilience/ tree and is NOT the allowed
+    POSIX-primitive site, so a direct os.replace in it fails tier-1."""
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    import check_storage_routing as lint
+    files = [os.path.relpath(f, _REPO) for f in lint._files()]
+    assert os.path.join("faster_distributed_training_tpu", "resilience",
+                        "executable_cache.py") in files
+    assert lint.check() == []                  # and it is clean today
+    # ...and a violation in it IS caught (write a scratch copy of the
+    # module with a rename, point the scanner at it)
+    with tempfile.TemporaryDirectory() as d:
+        scratch = os.path.join(d, "executable_cache.py")
+        with open(scratch, "w") as f:
+            f.write("import os\n\ndef bad(a, b):\n    os.replace(a, b)\n")
+        hits = lint._banned_calls(scratch)
+        assert hits and hits[0][1] == "os.replace"

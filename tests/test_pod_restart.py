@@ -462,6 +462,87 @@ class TestBuildResilienceWiring:
         finally:
             res.close()
 
+    def test_commit_timeout_tied_to_peer_timeout_when_armed(
+            self, tmp_path, monkeypatch):
+        """r17 satellite (the r14 follow-on): whenever a pod coordinator
+        is armed, the manager's commit-barrier timeout defaults to
+        O(peer_timeout_s) instead of the historic 600s — a barrier that
+        outlives peer detection turns every re-admission hold into a
+        pod_fallback_restart."""
+        monkeypatch.setenv(coord_mod.ENV_POD_INDEX, "0")
+        monkeypatch.setenv(coord_mod.ENV_POD_COUNT, "2")
+        res = build_resilience(
+            self._cfg(tmp_path, supervise=True, peer_timeout_s=20.0),
+            log=lambda *_: None)
+        try:
+            assert res.manager._commit_timeout_s == 40.0   # max(2x, 10)
+        finally:
+            res.close()
+        # a tiny peer timeout still gets the 10s floor
+        res = build_resilience(
+            self._cfg(tmp_path, supervise=True, peer_timeout_s=1.0),
+            log=lambda *_: None)
+        try:
+            assert res.manager._commit_timeout_s == 10.0
+        finally:
+            res.close()
+
+    def test_commit_timeout_unarmed_keeps_600_and_user_value_warns(
+            self, tmp_path, monkeypatch):
+        # no coordinator (single host, no supervise): historic default
+        res = build_resilience(self._cfg(tmp_path), log=lambda *_: None)
+        try:
+            assert res.manager._commit_timeout_s == 600.0
+        finally:
+            res.close()
+        # a user value that INVERTS the detection ordering warns
+        monkeypatch.setenv(coord_mod.ENV_POD_INDEX, "0")
+        monkeypatch.setenv(coord_mod.ENV_POD_COUNT, "2")
+        logs = []
+        res = build_resilience(
+            self._cfg(tmp_path, supervise=True, peer_timeout_s=60.0,
+                      commit_timeout_s=5.0),
+            log=logs.append)
+        try:
+            assert res.manager._commit_timeout_s == 5.0   # honored...
+            assert any("commit_timeout_s" in m and "WARNING" in m
+                       for m in logs)                     # ...but warned
+        finally:
+            res.close()
+        # ...and one that outlives the re-admission hold window warns too
+        monkeypatch.setenv(coord_mod.ENV_SLICE_COUNT, "2")
+        logs.clear()
+        res = build_resilience(
+            self._cfg(tmp_path, supervise=True, peer_timeout_s=10.0,
+                      readmit_timeout_s=30.0, commit_timeout_s=120.0),
+            log=logs.append)
+        try:
+            assert any("readmit_timeout_s" in m and "WARNING" in m
+                       for m in logs)
+        finally:
+            res.close()
+
+    def test_spare_env_builds_out_of_pod_identity(self, tmp_path,
+                                                  monkeypatch):
+        """r17 warm spares: FDT_SLICE_SPARE parks the bundle under a
+        synthetic out-of-pod index (pc + spare id) — its markers, shard
+        files and commit-barrier role can never collide with a
+        member's — and the coordinator carries the spare identity."""
+        monkeypatch.setenv(coord_mod.ENV_POD_COUNT, "2")
+        monkeypatch.setenv(coord_mod.ENV_SLICE_COUNT, "2")
+        monkeypatch.setenv(coord_mod.ENV_SLICE_SPARE, "0")
+        res = build_resilience(self._cfg(tmp_path, supervise=True),
+                               log=lambda *_: None)
+        try:
+            assert res.spare_index == 0
+            assert res.pod_index == 2           # pc + spare id
+            assert res.coordinator is not None
+            assert res.coordinator.spare_index == 0
+            assert res.coordinator.pi == 2
+            assert res.manager._pi == 2         # never commits/prunes
+        finally:
+            res.close()
+
     def test_step_timeout_without_supervise_warns(self, tmp_path):
         """r10 review fix: the hang watchdog lives on the coordinator,
         which only the supervised path builds — --step_timeout_s
@@ -748,6 +829,306 @@ class TestReadmissionProtocol:
         c0.close(), c1b.close()
 
 
+class TestWarmSpareProtocol:
+    """Unit drive of the r17 SPARE/CLAIM marker exchange (no train
+    loop): a parked spare claims a failed seat only once the survivors
+    are provably holding, arbitration is first-writer-wins, a
+    relaunched original finds the claim and stands down, and a
+    completed pod sends the spare home."""
+
+    def _spare(self, d, idx=0, pi=None):
+        c = PodCoordinator(
+            os.path.join(d, "_pod"), process_index=0, process_count=2,
+            sync_every=1, peer_timeout_s=30.0, slice_count=2,
+            readmit_timeout_s=10.0, spare_index=idx,
+            goodput=GoodputTracker(), log=lambda *_: None)
+        if pi is not None:
+            c.pi = pi
+        return c
+
+    def test_claim_waits_for_holds_then_swaps(self, tmp_path):
+        c0, c1 = _slice_pair(str(tmp_path))
+        c0.begin_attempt(), c1.begin_attempt()
+        c1.record_failure(RuntimeError("boom"), step=6)
+        c1.close()
+        sp = self._spare(str(tmp_path))
+        assert sp.pi == 2                  # synthetic out-of-pod index
+        # survivors not parked yet: no claim (racing the whole-pod path)
+        assert sp._spare_try_claim() is None
+        outcome = {}
+
+        def survivor():
+            try:
+                c0.check(6)                # foreign-slice FAIL -> parks
+                outcome["released"] = True
+            except BaseException as e:     # pragma: no cover - surfaced
+                outcome["error"] = e
+
+        t = threading.Thread(target=survivor, daemon=True)
+        t.start()
+        hold = os.path.join(c0._gen_path(0), "HOLD_s000_00000")
+        deadline = time.monotonic() + 5.0
+        while not os.path.exists(hold) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert os.path.exists(hold)
+        claim = sp._spare_try_claim()
+        assert claim == {"seat": 1, "slice": 1, "generation": 0}
+        assert sp.pi == 1 and sp.si == 1 and sp.rejoining
+        # first writer won: a second spare finds every seat claimed
+        sp2 = self._spare(str(tmp_path), idx=1, pi=3)
+        assert sp2._spare_try_claim() is None
+        # the spare completes the swap (restored step == target here)
+        sp.rejoin_sync(6)
+        t.join(timeout=10.0)
+        assert outcome.get("released") is True, outcome
+        s = sp._goodput.summary()
+        assert s["warm_spare_claims"] == 1
+        assert s["warm_spare_swaps"] == 1
+        assert s["warm_spare_swap_s"] > 0
+        assert c0._goodput.summary()["slice_readmissions"] == 1
+        sp.close(), sp2.close(), c0.close()
+
+    def test_relaunched_original_raises_seat_taken(self, tmp_path):
+        """The original host coming back after a spare claimed its seat
+        must stand down — two processes under one pod identity would
+        corrupt every barrier — and SeatTaken is not restartable (the
+        supervisor pass-through is pinned in test_resilience)."""
+        from faster_distributed_training_tpu.resilience import SeatTaken
+        c0, c1 = _slice_pair(str(tmp_path))
+        c0.begin_attempt(), c1.begin_attempt()
+        c1.record_failure(RuntimeError("boom"), step=6)
+        c1.close()
+        coord_mod._write_json_atomic(
+            os.path.join(c0._gen_path(0), "CLAIM_s001_00001"),
+            {"spare": 0})
+        c1b = PodCoordinator(
+            os.path.join(str(tmp_path), "_pod"), process_index=1,
+            process_count=2, sync_every=1, slice_index=1, slice_count=2,
+            readmit_timeout_s=10.0, goodput=GoodputTracker(),
+            log=lambda *_: None)
+        with pytest.raises(SeatTaken, match="warm spare"):
+            c1b.begin_attempt()
+        c1b.close(), c0.close()
+
+    def test_spare_stands_down_when_pod_completes(self, tmp_path):
+        c0, c1 = _slice_pair(str(tmp_path))
+        c0.begin_attempt(), c1.begin_attempt()
+        sp = self._spare(str(tmp_path))      # created BEFORE the EXITs
+        time.sleep(0.02)   # EXIT times are ms-rounded; step past the
+        #                    spare's creation stamp deterministically
+        c0.record_completion(step=16)
+        c1.record_completion(step=16)
+        refreshes = []
+        got = sp.spare_wait(refresh_fn=lambda: refreshes.append(1),
+                            poll_s=0.01)
+        assert got is None                   # stood down, nothing claimed
+        assert refreshes                     # the park loop did refresh
+        sp.close(), c0.close(), c1.close()
+
+    def test_original_rejoin_claims_seat_atomically(self, tmp_path):
+        """Review fix (TOCTOU): the relaunched ORIGINAL arbitrates its
+        seat through the same first-writer-wins CLAIM create_if_absent
+        a spare uses — a check-then-proceed would race a spare's claim
+        in the begin_attempt-to-first-rejoin-marker gap and put two
+        processes under one pod identity.  Winning blocks every spare;
+        a rejoin RETRY (our own earlier claim) still proceeds."""
+        c0, c1 = _slice_pair(str(tmp_path))
+        c0.begin_attempt(), c1.begin_attempt()
+        c1.record_failure(RuntimeError("boom"), step=6)
+        c1.close()
+        # survivors hold (so a spare WOULD otherwise claim)
+        coord_mod._write_json_atomic(
+            os.path.join(c0._gen_path(0), "HOLD_s000_00000"), {"step": 6})
+        c1b = PodCoordinator(
+            os.path.join(str(tmp_path), "_pod"), process_index=1,
+            process_count=2, sync_every=1, slice_index=1, slice_count=2,
+            readmit_timeout_s=10.0, goodput=GoodputTracker(),
+            log=lambda *_: None)
+        g = c1b.begin_attempt()
+        assert g == 0 and c1b.rejoining       # the original won its seat
+        claim = json.load(open(os.path.join(
+            c1b._gen_path(0), "CLAIM_s001_00001")))
+        assert claim["spare"] is None and claim["pi"] == 1
+        sp = self._spare(str(tmp_path))
+        assert sp._spare_try_claim() is None  # spare lost arbitration
+        # a retry by the SAME original (fresh process, same seat) finds
+        # its own claim and keeps the seat; the RJRENTER-residue rule
+        # then decides retry-vs-abort exactly as before
+        c1c = PodCoordinator(
+            os.path.join(str(tmp_path), "_pod"), process_index=1,
+            process_count=2, sync_every=1, slice_index=1, slice_count=2,
+            readmit_timeout_s=10.0, goodput=GoodputTracker(),
+            log=lambda *_: None)
+        assert c1c.begin_attempt() == 0 and c1c.rejoining
+        sp.close(), c0.close(), c1b.close(), c1c.close()
+
+    def test_malformed_spare_id_fails_fast(self):
+        """Review fix: two spares whose malformed ids both silently
+        mapped to 0 would collide on the synthetic pod index — a typo'd
+        launcher config must raise, not alias."""
+        with pytest.raises(ValueError, match="FDT_SLICE_SPARE"):
+            coord_mod.spare_identity(env={"FDT_SLICE_SPARE": "yes"})
+        assert coord_mod.spare_identity(env={}) is None
+        assert coord_mod.spare_identity(env={"FDT_SLICE_SPARE": "2"}) == 2
+
+    def test_spare_ignores_incident_already_rejoining(self, tmp_path):
+        """The real slice beat the spare to its own seat (RJRENTER in
+        the generation): the spare stands aside instead of racing it."""
+        c0, c1 = _slice_pair(str(tmp_path))
+        c0.begin_attempt(), c1.begin_attempt()
+        c1.record_failure(RuntimeError("boom"), step=6)
+        coord_mod._write_json_atomic(
+            os.path.join(c0._gen_path(0), "HOLD_s000_00000"), {"step": 6})
+        coord_mod._write_json_atomic(
+            os.path.join(c0._gen_path(0), "RJRENTER_s001_00001"),
+            {"step": 4})
+        sp = self._spare(str(tmp_path))
+        assert sp._spare_try_claim() is None
+        sp.close(), c0.close(), c1.close()
+
+
+def _run_spare(d, step_fn, state0, gp, total=_TOTAL):
+    """The spare side of the warm-spare e2e: park (programs already
+    warm — step_fn is the shared compiled program), claim, restore
+    through the slice-scoped barrier, catch up, release, finish the
+    run in the dead member's place."""
+    coord = PodCoordinator(
+        os.path.join(d, "_pod"), process_index=0, process_count=2,
+        sync_every=1, peer_timeout_s=30.0, slice_count=2,
+        readmit_timeout_s=30.0, spare_index=0, goodput=gp,
+        log=lambda *_: None)
+    claim = coord.spare_wait(poll_s=0.02)
+    if claim is None:
+        coord.close()
+        return None
+    mgr = AsyncCheckpointManager(
+        d, every_steps=_EVERY, process_index=coord.pi, process_count=2,
+        shard_owner=(lambda sh: False), commit_timeout_s=15.0,
+        step_gather_fn=coord.gather_restored_step, goodput=gp,
+        log=lambda *_: None)
+    coord.drain_fn = mgr.wait
+    try:
+        st, start = state0, 0
+        got = mgr.restore_latest(st)
+        if got is not None:
+            st, meta = got
+            start = int(meta["step"])
+        coord.rejoin_sync(start)
+        with coord.watch_steps():
+            for i in range(start + 1, total + 1):
+                st, _m = step_fn(st)
+                coord.check(i)
+                align = coord.consume_cadence_align()
+                if align is not None:
+                    mgr.align_cadence(align)
+                if not coord.saves_suspended:
+                    mgr.maybe_save(st, i)
+        mgr.wait()
+        coord.record_completion(step=total)
+        return st
+    finally:
+        mgr.close()
+        coord.close()
+
+
+class TestWarmSpareEndToEnd:
+    """ISSUE acceptance (r17): kill slice 1 for good -> the spare
+    claims its seat -> the survivor's HOLD is shorter than the
+    cold-rejoin twin's (which pays a fresh program build, the process-
+    relaunch reality) -> final states bitwise-equal to the
+    uninterrupted reference."""
+
+    @pytest.fixture(scope="class")
+    def program(self):
+        cfg, state, batch = _tiny_state()
+        step = jax.jit(make_train_step(cfg))
+        reference = state
+        for _ in range(_TOTAL):
+            reference, _m = step(reference, batch)
+        return cfg, state, batch, (lambda st: step(st, batch)), reference
+
+    def test_spare_swap_bitwise_and_faster_than_cold_rejoin(
+            self, program, tmp_path):
+        cfg, state, batch, step_fn, reference = program
+
+        # -- scenario A: warm spare; the victim has NO restart budget
+        # (dead for good — the platform never relaunches it)
+        d = str(tmp_path / "spare")
+        barrier = threading.Barrier(2)
+        kw = dict(pc=2, readmit_timeout_s=30.0, step_delay=0.02,
+                  slice_count=2)
+        h0 = _SimHost(0, d, barrier, slice_index=0, **kw)
+        h1 = _SimHost(1, d, barrier, faults=FaultPlan(die_at=6),
+                      slice_index=1, max_restarts=0, **kw)
+        gp_spare = GoodputTracker().start()
+        results, errors = {}, {}
+
+        def run_host(h):
+            try:
+                results[h.pi] = h.run(step_fn, state)
+            except BaseException as e:
+                errors[h.pi] = e
+
+        def run_sp():
+            try:
+                results["spare"] = _run_spare(d, step_fn, state, gp_spare)
+            except BaseException as e:     # pragma: no cover - surfaced
+                errors["spare"] = e
+
+        threads = [threading.Thread(target=run_host, args=(h,),
+                                    daemon=True) for h in (h0, h1)]
+        threads.append(threading.Thread(target=run_sp, daemon=True))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not any(t.is_alive() for t in threads), "spare pod hung"
+        # the victim died for good, by design; nothing else may fail
+        assert isinstance(errors.pop(1, None), faults_mod.InjectedFault)
+        assert not errors, f"unexpected failures: {errors!r}"
+        # survivor: held once, never restarted, never rolled back
+        s0 = h0.goodput.summary()
+        assert s0["restarts"] == 0 and s0["restores"] == 0
+        assert s0["slice_readmissions"] == 1
+        spare_hold = s0["readmission_hold_s"]
+        assert spare_hold > 0
+        # spare: claimed + swapped, finished bitwise-correct
+        ssp = gp_spare.summary()
+        assert ssp["warm_spare_claims"] == 1
+        assert ssp["warm_spare_swaps"] == 1
+        _assert_tree_equal(ckpt._state_pytree(results["spare"]),
+                           ckpt._state_pytree(reference))
+        _assert_tree_equal(ckpt._state_pytree(results[0]),
+                           ckpt._state_pytree(reference))
+
+        # -- scenario B: cold-rejoin twin — no spare; the killed slice
+        # restarts and rejoins through a FRESHLY BUILT program (a new
+        # jax.jit recompiles: the relaunch reality a restarted slice
+        # pays), so the survivor's hold covers that compile
+        d2 = str(tmp_path / "cold")
+        barrier2 = threading.Barrier(2)
+
+        def fresh_program():
+            fresh = jax.jit(make_train_step(cfg))
+            return lambda st: fresh(st, batch)
+
+        c0 = _SimHost(0, d2, barrier2, slice_index=0, **kw)
+        c1 = _SimHost(1, d2, barrier2, faults=FaultPlan(die_at=6),
+                      slice_index=1, fresh_program_fn=fresh_program, **kw)
+        results2 = _run_pod([c0, c1], step_fn, state)
+        s0c = c0.goodput.summary()
+        assert s0c["slice_readmissions"] == 1
+        cold_hold = s0c["readmission_hold_s"]
+        for pi in (0, 1):
+            _assert_tree_equal(ckpt._state_pytree(results2[pi]),
+                               ckpt._state_pytree(reference))
+        # the tentpole claim, measured: the warm spare's swap keeps the
+        # survivors parked for LESS time than a cold rejoin that must
+        # rebuild its programs
+        assert spare_hold < cold_hold, \
+            f"spare hold {spare_hold:.3f}s !< cold hold {cold_hold:.3f}s"
+
+
 class TestSimulatedSlicePodEndToEnd:
     """ISSUE acceptance (r14): simulated 2-slice pod, 4 hosts, slice 1
     killed whole mid-run — the surviving slice parks (never exits its
@@ -831,9 +1212,16 @@ class _SimHost:
     cadence re-align after check, saves gated on saves_suspended."""
 
     def __init__(self, pi, d, barrier, faults=None, total=_TOTAL,
-                 pc=2, backend=None, step_delay=0.0, **coord_kw):
+                 pc=2, backend=None, step_delay=0.0, max_restarts=3,
+                 fresh_program_fn=None, **coord_kw):
         self.pi, self.total, self.barrier = pi, total, barrier
         self.step_delay = step_delay
+        # r17 cold-rejoin twin: when set, every RESTART attempt steps
+        # through fresh_program_fn() instead of the shared warm step_fn
+        # — a fresh jax.jit recompiles, modeling the process relaunch a
+        # real restarted slice pays (the warm-spare e2e measures the
+        # survivor hold against exactly this)
+        self.fresh_program_fn = fresh_program_fn
         self.goodput = GoodputTracker()
         coord_kw.setdefault("sync_every", 1)
         coord_kw.setdefault("peer_timeout_s", 30.0)
@@ -850,7 +1238,7 @@ class _SimHost:
             goodput=self.goodput, log=lambda *_: None)
         self.coord.drain_fn = self.mgr.wait
         self.faults = faults
-        self.sup = Supervisor(max_restarts=3, backoff_base=0.01,
+        self.sup = Supervisor(max_restarts=max_restarts, backoff_base=0.01,
                               goodput=self.goodput, log=lambda *_: None,
                               coordinator=self.coord)
         self.progress = 0
@@ -867,6 +1255,9 @@ class _SimHost:
     def run(self, step_fn, state0):
         def attempt(_i):
             try:
+                fn = step_fn
+                if self.fresh_program_fn is not None and _i > 0:
+                    fn = self.fresh_program_fn()
                 self.generations.append(self.coord._gen)
                 st, start = state0, 0
                 got = self.mgr.restore_latest(st)
@@ -884,7 +1275,7 @@ class _SimHost:
                 with self.coord.watch_steps():
                     for i in range(start + 1, self.total + 1):
                         self._lockstep()
-                        st, _m = step_fn(st)
+                        st, _m = fn(st)
                         self.progress = i
                         if self.faults is not None:
                             self.faults.on_step(i)
@@ -1057,6 +1448,22 @@ def test_pod_restart_smoke_fake_object_store(monkeypatch):
     mod = _load_smoke_module(monkeypatch)
     assert mod.main(ref_digest=_smoke_reference_digest(mod),
                     backend="fake_object_store") == 0
+
+
+def test_pod_restart_smoke_cache(monkeypatch):
+    """r17 acceptance: scripts/pod_restart_smoke.py --cache — crash +
+    process relaunch with the executable cache armed: the relaunched
+    process records cache_source=deserialized for EVERY steady-state
+    program, zero retraces, bitwise-equal final state.  Budget mode
+    (cache_cold_twin=False): the digest compares against the
+    UNINTERRUPTED reference, which the resilience e2e suite already
+    pins bitwise-equal to a cold restart (kill-at-N resume, r7), and
+    the cold-acquisition A/B stays with the bench restart_mttr_s vs
+    restart_cached_mttr_s arms — the manual script run keeps the full
+    cold twin (~25 s of extra compile this wrapper spares tier-1)."""
+    mod = _load_smoke_module(monkeypatch)
+    assert mod.main(ref_digest=_smoke_reference_digest(mod),
+                    cache=True, cache_cold_twin=False) == 0
 
 
 @pytest.mark.slow

@@ -263,14 +263,29 @@ class TestSupervisor:
         progress = iter([3, 7])   # failures at different steps: transient
         assert sup.run(attempt, lambda: next(progress)) == "done"
         assert calls == [0, 1, 2]
-        assert sleeps == [0.25, 0.5]   # exponential backoff
+        # r17: the FIRST restart is immediate (no sleep at all — the
+        # measured 1.07s MTTR was ~1.0s of base backoff paid on one
+        # transient fault); the exponential ramp starts at the second
+        assert sleeps == [0.25]
+
+    def test_first_restart_immediate_backoff_from_second(self):
+        """r17 satellite pin: one transient failure recovers with ZERO
+        backoff (restart_mttr_backoff_s ≈ 0), repeated failures ramp
+        base·2^k from the second restart, still capped."""
+        sup, sleeps = self._supervisor(max_restarts=4, backoff_cap=0.6)
+        steps = iter([1, 2, 3, 4, 5])
+        with pytest.raises(RuntimeError):
+            sup.run(lambda i: (_ for _ in ()).throw(RuntimeError("x")),
+                    lambda: next(steps))
+        # restarts 1..4 -> delays 0 (immediate), 0.25, 0.5, 0.6 (capped)
+        assert sleeps == [0.25, 0.5, 0.6]
 
     def test_deterministic_crash_reraises_with_budget_left(self):
         sup, sleeps = self._supervisor(max_restarts=10)
         with pytest.raises(RuntimeError, match="boom"):
             sup.run(lambda i: (_ for _ in ()).throw(RuntimeError("boom")),
                     lambda: 5)   # same step every time
-        assert len(sleeps) == 1   # one retry, then the same-step re-raise
+        assert sleeps == []   # one (immediate) retry, then the re-raise
 
     def test_same_step_different_exception_types_keep_retrying(self):
         """r10 satellite fix: two DIFFERENT transient faults landing at
@@ -287,7 +302,7 @@ class TestSupervisor:
             return "done"
 
         assert sup.run(attempt, lambda: 5) == "done"   # same step each time
-        assert len(sleeps) == 2      # both failures retried, none fatal
+        assert len(sleeps) == 1      # both retried (first immediate)
 
     def test_peer_failure_never_deterministic(self):
         """r10 review fix: a PeerFailure's step is the poll-quantized
@@ -301,7 +316,7 @@ class TestSupervisor:
         with pytest.raises(PeerFailure):    # budget-exhausted, not
             sup.run(lambda i: (_ for _ in ()).throw(   # deterministic
                 PeerFailure("host 1 flapping")), lambda: 5)
-        assert len(sleeps) == 3             # every restart was burned
+        assert len(sleeps) == 2     # every restart burned (first immediate)
         # ...and an own-crash recurring at one step with a peer incident
         # in between is STILL deterministic (PeerFailure is transparent)
         sup, sleeps = self._supervisor(max_restarts=10)
@@ -309,7 +324,7 @@ class TestSupervisor:
                      RuntimeError("bad batch")])
         with pytest.raises(RuntimeError, match="bad batch"):
             sup.run(lambda i: (_ for _ in ()).throw(next(excs)), lambda: 5)
-        assert len(sleeps) == 2   # two retries, then the re-raise
+        assert len(sleeps) == 1   # two retries (first immediate), re-raise
 
     def test_success_records_completion_on_coordinator(self):
         """r10 review fix: a finishing host durably marks itself DONE so
@@ -345,7 +360,7 @@ class TestSupervisor:
         with pytest.raises(RuntimeError, match="init"):
             sup.run(lambda i: (_ for _ in ()).throw(RuntimeError("init")),
                     lambda: None)
-        assert len(sleeps) == 1   # one retry, then the re-raise
+        assert sleeps == []   # one (immediate) retry, then the re-raise
 
     def test_bounded_restarts(self):
         sup, sleeps = self._supervisor(max_restarts=2, backoff_cap=0.3)
@@ -353,7 +368,8 @@ class TestSupervisor:
         with pytest.raises(RuntimeError):
             sup.run(lambda i: (_ for _ in ()).throw(RuntimeError("x")),
                     lambda: next(steps))
-        assert sleeps == [0.25, 0.3]   # capped, then budget exhausted
+        # restart 1 immediate, restart 2 at base; budget exhausted
+        assert sleeps == [0.25]
 
     def test_preempted_passes_through(self):
         sup, sleeps = self._supervisor(max_restarts=5)
@@ -361,6 +377,19 @@ class TestSupervisor:
             sup.run(lambda i: (_ for _ in ()).throw(Preempted("p")),
                     lambda: 1)
         assert sleeps == []   # never treated as a failure
+
+    def test_seat_taken_passes_through(self):
+        """r17 warm spares: SeatTaken is protocol, not failure — a
+        spare durably claimed this host's seat and retrying can never
+        win it back, so the supervisor re-raises immediately instead of
+        burning the restart budget against a first-writer-wins
+        marker."""
+        from faster_distributed_training_tpu.resilience import SeatTaken
+        sup, sleeps = self._supervisor(max_restarts=5)
+        with pytest.raises(SeatTaken):
+            sup.run(lambda i: (_ for _ in ()).throw(
+                SeatTaken("spare 0 holds seat 1")), lambda: 1)
+        assert sleeps == []   # zero retries
 
 
 class TestPreemptionHandler:
@@ -406,6 +435,43 @@ class TestGoodput:
         s = g.summary()
         assert s["restart_mttr_s"] == 1.5          # NOT (5.0+0.5+1.0)/1
         assert s["restore_s"] == 5.5               # total still accounted
+
+    def test_mttr_splits_into_compile_and_restore(self):
+        """r17 tentpole: restart_mttr_s = detect + backoff + recovery
+        restore + recovery COMPILE (program re-acquisition, the
+        compile-dominated real-hardware half restore_s alone can't
+        see), with the two halves published as components — and, like
+        restore, compile time paid BEFORE the first restart is startup,
+        not recovery."""
+        g = GoodputTracker().start()
+        g.add_compile(3.0)               # the run's first-start compiles
+        g.add("restore_s", 5.0)          # --resume startup restore
+        g.count("restarts")              # then one crash
+        g.add("restore_s", 0.5)          # recovery restore
+        g.add_compile(2.0)               # recovery recompile
+        s = g.summary()
+        assert s["compile_s"] == 5.0                    # total accounted
+        assert s["restart_mttr_restore_s"] == 0.5
+        assert s["restart_mttr_compile_s"] == 2.0
+        assert s["restart_mttr_s"] == 2.5               # 0.5 + 2.0
+
+    def test_warm_spare_swap_published_but_not_badput(self):
+        """Review fix: the swap window CONTAINS the restore segment and
+        productive catch-up steps — it is published in the summary but
+        never summed into badput (double-billing would understate the
+        spare's goodput_pct)."""
+        clock = iter([0.0, 10.0]).__next__      # start, summary
+        g = GoodputTracker(clock=clock)
+        g.start()
+        g.add("restore_s", 2.0)                 # inside the swap window
+        g.add_warm_spare_swap(5.0)              # the whole swap
+        g.count("warm_spare_claims")
+        g.count("warm_spare_swaps")
+        s = g.summary()
+        assert s["warm_spare_swap_s"] == 5.0
+        assert s["warm_spare_claims"] == 1 and s["warm_spare_swaps"] == 1
+        assert s["badput_s"] == 2.0             # restore only, not 7.0
+        assert s["productive_s"] == 8.0
 
     def test_metrics_surface(self):
         from faster_distributed_training_tpu.train.metrics import (
