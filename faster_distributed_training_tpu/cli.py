@@ -218,6 +218,17 @@ def load_dataset(cfg: TrainConfig, train: bool):
     if os.environ.get("FDT_SYNTH_NOISE"):
         synth_kw["noise_std"] = float(os.environ["FDT_SYNTH_NOISE"])
 
+    if cfg.dataset == "stream":
+        # sharded on-disk dataset (data/stream/): the text flavor IS the
+        # reader (it speaks encode_batch, so host/resident paths serve
+        # it too — the cross-path bitwise tests depend on that); the
+        # image flavor returns the (image, label) mmap pair
+        from faster_distributed_training_tpu.data.stream import (
+            open_stream_split)
+        if not cfg.stream_dir:
+            raise ValueError("--dataset stream requires --stream_dir "
+                             "(scripts/shard_dataset.py writes one)")
+        return open_stream_split(cfg.stream_dir, train=train)
     if cfg.dataset == "cifar10":
         try:
             x, y = load_cifar10(cfg.data_dir, train=train)
@@ -590,7 +601,8 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                          dtype=dtype, remat=cfg.remat,
                          remat_policy=cfg.remat_policy,
                          dropout_impl=cfg.dropout_impl, ffn_impl=ffn_impl,
-                         fused_qkv=not tricks_off, quant=quant)
+                         fused_qkv=not tricks_off, quant=quant,
+                         lm_head=getattr(cfg, "task", "cls") == "lm")
     if (getattr(cfg, "quant", "none") or "none") != "none":
         import warnings
         warnings.warn(
@@ -757,8 +769,37 @@ def run_training(cfg: TrainConfig,
     mesh = make_mesh(cfg.mesh_axes, cfg.mesh_shape)
     is_text = cfg.model == "transformer"
 
+    if cfg.data_path == "stream":
+        if cfg.dataset != "stream":
+            raise ValueError(
+                f"--data_path stream reads the sharded on-disk format; "
+                f"use --dataset stream --stream_dir <dir> (got dataset="
+                f"{cfg.dataset!r}; scripts/shard_dataset.py shards a "
+                f"corpus/split into that format)")
+        if cfg.subset_stride > 1:
+            raise ValueError("--subset_stride is not supported with "
+                             "--data_path stream (the window refill "
+                             "addresses the full on-disk index space); "
+                             "shard a smaller dataset instead")
     train_ds = apply_subset(load_dataset(cfg, train=True), cfg.subset_stride)
     eval_ds = apply_subset(load_dataset(cfg, train=False), cfg.subset_stride)
+    if cfg.dataset == "stream" and is_text:
+        if (train_ds.manifest.get("content") == "lm"
+                and getattr(cfg, "task", "cls") != "lm"):
+            # the packed LM rows carry NO labels — the reader fabricates
+            # zero labels purely as shape placeholders, so a cls run
+            # would "learn" constant class 0 to 100% accuracy silently
+            raise ValueError(
+                f"{cfg.stream_dir} is an LM-content corpus (packed token "
+                f"rows, no labels) but --task is {cfg.task!r} — train it "
+                f"with --task lm")
+        # pre-tokenized packed rows have ONE width; the model's maxlen
+        # and every bucket decision must agree with it
+        sl = int(getattr(train_ds, "seq_len", 0) or 0)
+        if sl and sl != cfg.seq_len:
+            log(f"[data] stream dataset rows are seq_len={sl}; "
+                f"overriding --seq_len {cfg.seq_len}")
+            cfg = cfg.replace(seq_len=sl)
     vocab = train_ds.vocab_size() if is_text else None
     model = build_model(cfg, vocab_size=vocab, mesh=mesh)
 
@@ -831,6 +872,20 @@ def run_training(cfg: TrainConfig,
     # builds the batch-major view the dispatch indexes locally)
     from faster_distributed_training_tpu.data.device_resident import (
         build_device_resident)
+    # --data_path stream (r18): the split stays on disk; a fixed device
+    # window (2 buffers x stream_window batches) is refilled by a
+    # background double-buffered H2D thread (data/stream/window.py)
+    from faster_distributed_training_tpu.data.stream import build_stream
+    # the text flavor's train_ds IS the open reader — reuse its mmaps
+    stream = build_stream(cfg, mesh=mesh, dataset=train_ds)
+    if stream is not None:
+        log(f"[data] streaming train split from disk: {stream.n} samples "
+            f"({stream.dataset.nbytes_on_disk / 1e6:.0f} MB on disk, "
+            f"{len(stream.dataset.manifest['shards'])} shard(s)), device "
+            f"window 2x{stream.window} batches "
+            f"(peak ~{stream.nbytes / 1e6:.1f} MB/host), "
+            f"{stream.steps_per_epoch} steps/epoch"
+            + (f", seq_len={stream.seq_len}" if stream.is_text else ""))
     resident = build_device_resident(cfg, train_ds, mesh=mesh)
     if resident is not None:
         layout = ("sharded" if getattr(resident, "batch_major", False)
@@ -968,7 +1023,8 @@ def run_training(cfg: TrainConfig,
                           put_eval_batch=put_eval, log=log,
                           state_shardings=shardings, resilience=res,
                           put_stacked=put_stacked, resident=resident,
-                          telemetry=telemetry, profiler=profiler)
+                          telemetry=telemetry, profiler=profiler,
+                          stream=stream)
 
         # restored states (host numpy) must land back on the run's
         # sharding policy — placement.place_on_shardings, shared with
@@ -1113,6 +1169,13 @@ def run_training(cfg: TrainConfig,
                    f"{prefix} epoch time", f"{prefix}_time.png")
     out = {"state": state, "history": trainer.history,
            "best_acc": trainer.best_acc, "cfg": cfg}
+    if stream is not None and trainer.stream_stall_pct is not None:
+        # the streamed input path's headline: steady-state % of step
+        # time blocked on the window refill (<1% target, bench arm
+        # stream_stall_pct measures it under the guard)
+        out["stream_stall_pct"] = round(trainer.stream_stall_pct, 3)
+        log(f"[stream] steady-state stall: {out['stream_stall_pct']}% of "
+            f"step time blocked on the data window (target <1%)")
     if telemetry is not None:
         out["telemetry_dir"] = telemetry.directory
     if res is not None:

@@ -21,8 +21,18 @@ class TrainConfig:
 
     # -- workload ---------------------------------------------------------
     model: str = "resnet50"           # resnet18/34/50/101/152 | transformer
-    dataset: str = "cifar10"          # cifar10 | agnews | synthetic
+    dataset: str = "cifar10"          # cifar10 | agnews | synthetic |
+                                      # stream (a sharded on-disk dataset
+                                      # under --stream_dir, data/stream/)
     num_classes: int = 10
+    task: str = "cls"                 # cls | lm: the training objective.
+                                      # "lm" (transformer only) = next-
+                                      # token prediction — per-position
+                                      # vocab logits (lm_head), shifted-
+                                      # target token cross-entropy,
+                                      # perplexity metric; no mixup/
+                                      # pooler.  The streamed text
+                                      # workload's objective (r18)
 
     # -- optimization (reference flag surface) ----------------------------
     lr: float = 0.1
@@ -104,14 +114,35 @@ class TrainConfig:
     seq_len: int = 512                # transformer max length
     seq_buckets: Tuple[int, ...] = (64, 128, 256, 512)
     prefetch_depth: int = 2
-    data_path: str = "host"           # host | resident: "resident" uploads
-                                      # the train split to device once
-                                      # (uint8 images / int32 token ids) and
-                                      # gathers each batch inside the jitted
-                                      # dispatch (data/device_resident.py);
-                                      # works single-host (replicated) AND
-                                      # on pods (per-host sharded — see
-                                      # resident_layout)
+    data_path: str = "host"           # host | resident | stream:
+                                      # "resident" uploads the train split
+                                      # to device once (uint8 images /
+                                      # int32 token ids) and gathers each
+                                      # batch inside the jitted dispatch
+                                      # (data/device_resident.py); works
+                                      # single-host (replicated) AND on
+                                      # pods (per-host sharded — see
+                                      # resident_layout).  "stream" (r18)
+                                      # keeps the split ON DISK in the
+                                      # sharded stream format (requires
+                                      # --dataset stream + --stream_dir)
+                                      # and trains through a fixed device
+                                      # window refilled by a background
+                                      # double-buffered H2D thread — the
+                                      # beyond-HBM tier (data/stream/)
+    stream_dir: str = ""              # root of a sharded stream dataset
+                                      # (train/ + test/ subdirs, each with
+                                      # manifest.json + shard_*.npy —
+                                      # scripts/shard_dataset.py writes
+                                      # one); required by
+                                      # --dataset/--data_path stream
+    stream_window: int = 8            # batches per stream buffer (two
+                                      # buffers double-buffer; a third is
+                                      # transiently in flight in the
+                                      # refill thread).  Rounded UP to a
+                                      # multiple of --steps_per_dispatch
+                                      # so buffer boundaries stay
+                                      # dispatch-aligned (warned)
     resident_layout: str = "auto"     # auto | replicated | sharded: how the
                                       # resident split is placed.  auto =
                                       # replicated on one host (the r8
@@ -584,13 +615,33 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--debug", action="store_true",
                    help="per-epoch NGD Fisher invariant self-tests")
     p.add_argument("--data_path", default=d.data_path,
-                   choices=["host", "resident"],
+                   choices=["host", "resident", "stream"],
                    help="input pipeline: host = BatchLoader + prefetch + "
                         "per-batch H2D (default), resident = train split "
                         "uploaded to device once and batches gathered "
                         "inside the jitted dispatch (zero steady-state "
                         "host work; multi-host via per-host sharded "
-                        "residency, see --resident_layout)")
+                        "residency, see --resident_layout), stream = the "
+                        "split stays ON DISK (sharded stream format, "
+                        "--stream_dir) and trains through a fixed device "
+                        "window refilled by a background double-buffered "
+                        "H2D thread — the beyond-HBM tier; stall guarded "
+                        "<1% by bench stream_stall_pct")
+    p.add_argument("--task", default=d.task, choices=["cls", "lm"],
+                   help="training objective: cls = classification (the "
+                        "reference's), lm = next-token prediction through "
+                        "the transformer (per-position vocab logits, "
+                        "shifted-target loss, perplexity metric; no "
+                        "mixup) — the streamed LM workload")
+    p.add_argument("--stream_dir", default=d.stream_dir, type=str,
+                   help="sharded stream dataset root (train/ + test/ "
+                        "subdirs; scripts/shard_dataset.py writes one) — "
+                        "required by --dataset stream / --data_path "
+                        "stream")
+    p.add_argument("--stream_window", default=d.stream_window, type=int,
+                   help="batches per stream buffer (double-buffered; "
+                        "rounded up to a multiple of "
+                        "--steps_per_dispatch)")
     p.add_argument("--resident_layout", default=d.resident_layout,
                    choices=["auto", "replicated", "sharded"],
                    help="placement of the resident split: auto = "
@@ -737,6 +788,9 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         executable_cache=args.executable_cache,
         warm_spares=args.warm_spares,
         data_path=args.data_path,
+        task=args.task,
+        stream_dir=args.stream_dir,
+        stream_window=args.stream_window,
         resident_layout=args.resident_layout,
         steps_per_dispatch=args.steps_per_dispatch,
         seq_len=args.seq_len, n_layers=args.n_layers, d_model=args.d_model,

@@ -12,60 +12,131 @@ from __future__ import annotations
 
 import gzip
 import hashlib
+import http.client
 import os
 import tarfile
+import time
 import urllib.error
 import urllib.request
 import zipfile
-from typing import Optional
+from typing import Callable, Optional
 
 
-def check_md5(path: str, md5: str, chunk: int = 1 << 20) -> bool:
-    """torchvision_utils.py:82-91 equivalent."""
-    h = hashlib.md5()
+class ChecksumError(RuntimeError):
+    """A fetched file failed md5/sha256 verification.  RETRYABLE: the
+    dominant real-world cause is a truncated/corrupted transfer, which a
+    re-fetch fixes — a genuinely wrong upstream file exhausts the retry
+    budget and surfaces with the mismatch in the message."""
+
+
+def _hash_file(path: str, algo: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.new(algo)
     with open(path, "rb") as f:
         while True:
             block = f.read(chunk)
             if not block:
                 break
             h.update(block)
-    return h.hexdigest() == md5
+    return h.hexdigest()
 
 
-def check_integrity(path: str, md5: Optional[str] = None) -> bool:
+def check_md5(path: str, md5: str, chunk: int = 1 << 20) -> bool:
+    """torchvision_utils.py:82-91 equivalent."""
+    return _hash_file(path, "md5", chunk) == md5
+
+
+def check_sha256(path: str, sha256: str, chunk: int = 1 << 20) -> bool:
+    return _hash_file(path, "sha256", chunk) == sha256
+
+
+def check_integrity(path: str, md5: Optional[str] = None,
+                    sha256: Optional[str] = None) -> bool:
     if not os.path.isfile(path):
         return False
-    return True if md5 is None else check_md5(path, md5)
+    if md5 is not None and not check_md5(path, md5):
+        return False
+    if sha256 is not None and not check_sha256(path, sha256):
+        return False
+    return True
+
+
+def _verify_checksums(path: str, md5: Optional[str],
+                      sha256: Optional[str]) -> None:
+    for algo, want in (("md5", md5), ("sha256", sha256)):
+        if want is None:
+            continue
+        got = _hash_file(path, algo)
+        if got != want:
+            raise ChecksumError(
+                f"{algo} mismatch for {path}: got {got}, expected {want} "
+                f"(truncated/corrupt transfer, or the upstream file "
+                f"changed)")
+
+
+def _urlopen_fetch(url: str, path: str, timeout: float = 30.0) -> None:
+    """Default fetcher: stream the URL to ``path`` in 1 MB blocks.  The
+    injectable seam retry tests (and alternative transports) replace."""
+    req = urllib.request.Request(url, headers={"User-Agent": "fdt-tpu"})
+    with urllib.request.urlopen(req, timeout=timeout) as r, \
+            open(path, "wb") as f:
+        while True:
+            block = r.read(1 << 20)
+            if not block:
+                break
+            f.write(block)
 
 
 def download_url(url: str, root: str, filename: Optional[str] = None,
-                 md5: Optional[str] = None) -> str:
+                 md5: Optional[str] = None, sha256: Optional[str] = None,
+                 attempts: int = 3, backoff_s: float = 1.0,
+                 fetch: Optional[Callable[[str, str], None]] = None,
+                 sleep: Callable[[float], None] = time.sleep) -> str:
+    """Fetch ``url`` into ``root`` with BOUNDED retry/backoff and
+    checksum verification (r18 hardening — a single flaky connection
+    used to fail the whole run outright).
+
+      * up to ``attempts`` tries; exponential backoff between them
+        (``backoff_s * 2^(attempt-1)``, injected ``sleep`` for tests);
+      * every failed/torn attempt deletes the partial file — a truncated
+        archive can never be cached as the dataset;
+      * ``md5``/``sha256`` verify EACH attempt's payload; a mismatch is
+        retried like a network error (truncation is the common cause)
+        and only exhausts the budget if persistent;
+      * ``fetch(url, path)`` is the injectable transport seam.
+
+    Returns the verified path; raises RuntimeError (chained to the last
+    underlying error) when the budget is exhausted."""
     os.makedirs(root, exist_ok=True)
     filename = filename or os.path.basename(url)
     path = os.path.join(root, filename)
-    if check_integrity(path, md5):
+    if check_integrity(path, md5, sha256):
         return path
-    try:
-        req = urllib.request.Request(url, headers={"User-Agent": "fdt-tpu"})
-        with urllib.request.urlopen(req, timeout=30) as r, \
-                open(path, "wb") as f:
-            while True:
-                block = r.read(1 << 20)
-                if not block:
-                    break
-                f.write(block)
-    except (urllib.error.URLError, OSError) as e:
-        # never leave a partial file behind: check_integrity(md5=None)
-        # would return it as the dataset on the next call
-        if os.path.exists(path):
-            os.remove(path)
-        raise RuntimeError(
-            f"could not download {url} ({e}); in offline environments "
-            f"place the file at {path} manually or use the synthetic "
-            f"dataset (data.synthetic)") from e
-    if md5 and not check_md5(path, md5):
-        raise RuntimeError(f"MD5 mismatch for {path}")
-    return path
+    attempts = max(int(attempts), 1)
+    fetch = fetch or _urlopen_fetch
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        if attempt:
+            sleep(backoff_s * (2 ** (attempt - 1)))
+        try:
+            fetch(url, path)
+            _verify_checksums(path, md5, sha256)
+            return path
+        except (urllib.error.URLError, OSError, ChecksumError,
+                http.client.HTTPException) as e:
+            # HTTPException covers mid-body disconnects (IncompleteRead,
+            # BadStatusLine) that are neither URLError nor OSError — they
+            # must hit the same delete-partial + retry path or a torn
+            # file survives for the next check_integrity(md5=None) call
+            # never leave a partial/corrupt file behind:
+            # check_integrity(md5=None) would return it as the dataset
+            # on the next call
+            if os.path.exists(path):
+                os.remove(path)
+            last = e
+    raise RuntimeError(
+        f"could not download {url} after {attempts} attempt(s) ({last}); "
+        f"in offline environments place the file at {path} manually or "
+        f"use the synthetic dataset (data.synthetic)") from last
 
 
 def extract_archive(path: str, dest: Optional[str] = None) -> str:
@@ -87,9 +158,14 @@ def extract_archive(path: str, dest: Optional[str] = None) -> str:
 
 
 def download_and_extract_archive(url: str, root: str,
-                                 md5: Optional[str] = None) -> str:
-    """torchvision_utils.py:424-442 equivalent."""
-    path = download_url(url, root, md5=md5)
+                                 md5: Optional[str] = None,
+                                 sha256: Optional[str] = None,
+                                 attempts: int = 3,
+                                 backoff_s: float = 1.0) -> str:
+    """torchvision_utils.py:424-442 equivalent (retry/checksum args
+    pass through to the hardened download_url)."""
+    path = download_url(url, root, md5=md5, sha256=sha256,
+                        attempts=attempts, backoff_s=backoff_s)
     return extract_archive(path, root)
 
 

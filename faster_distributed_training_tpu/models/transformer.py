@@ -578,6 +578,18 @@ class Transformer(nn.Module):
                                    # projections + FFN with delayed
                                    # per-tensor scaling; scale state
                                    # rides the batch_stats collection
+    lm_head: bool = False          # --task lm (r18): per-position vocab
+                                   # logits for next-token prediction
+                                   # instead of the CLS pooler/classifier
+                                   # — the streamed LM workload's head.
+                                   # Untied projection (the tp vocab-
+                                   # sharding rules match by param name;
+                                   # "lm_head" stays replicated — tying
+                                   # it to token_embedding is a
+                                   # follow-on).  No mixup: sentence-
+                                   # embedding mixup is a classification
+                                   # regularizer with no analog on a
+                                   # dense token objective
 
     @nn.compact
     def __call__(self, x: jax.Array, token_types: Optional[jax.Array] = None,
@@ -659,6 +671,17 @@ class Transformer(nn.Module):
         # closing step and a deliberate, documented fix (same category
         # as the eval-mixup and -1e-9 mask fixes above).
         h = ln("ln_final")(h)
+
+        if self.lm_head:
+            # next-token LM head: fp32 logits over the vocab at every
+            # position (the loss shifts targets, train/steps.py).  Same
+            # return shape train and eval — the mixup triplet below is
+            # classification-only.
+            logits = nn.Dense(self.vocab, kernel_init=xavier_uniform,
+                              dtype=self.dtype,
+                              param_dtype=self.param_dtype,
+                              name="lm_head")(h)
+            return logits.astype(jnp.float32)
 
         # Pooler: tanh(dense(CLS)) (transformer.py:94-101)
         pooled = nn.tanh(nn.Dense(self.d_model, kernel_init=xavier_uniform,

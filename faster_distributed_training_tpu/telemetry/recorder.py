@@ -126,9 +126,12 @@ TELEMETRY_SCHEMA: Dict[str, Optional[frozenset]] = {
                        "dispatch_ms", "data_ms", "block_ms", "examples",
                        "ex_s", "compile"}),
     "span": frozenset({"name", "dur_ms", "step"}),
+    # perplexity/eval_perplexity (r18 LM workload, append-only): only
+    # emitted on --task lm runs (exp of the token-weighted epoch loss)
     "epoch": frozenset({"epoch", "steps", "trained_steps", "loss",
                         "accuracy", "wall_s", "ex_s", "peak_mem_bytes",
-                        "eval_loss", "eval_accuracy"}),
+                        "eval_loss", "eval_accuracy", "perplexity",
+                        "eval_perplexity"}),
     "goodput": None,
     "goodput_event": frozenset({"counter", "total"}),
     "rollback": frozenset({"epoch", "restored_epoch", "step"}),
@@ -158,6 +161,13 @@ TELEMETRY_SCHEMA: Dict[str, Optional[frozenset]] = {
                               "dispatch_ms", "attempts"}),
     "serve_request": frozenset({"bucket", "len", "queue_ms", "total_ms",
                                 "replica"}),
+    # r18 streaming data plane (data/stream/window.py) — append-only:
+    # one stream_refill per background buffer fill (disk read + H2D
+    # split out), one stream_stall per buffer swap the consumer had to
+    # wait for (the numerator of bench's stream_stall_pct, <1% target)
+    "stream_refill": frozenset({"epoch", "base", "batches", "bytes",
+                                "read_ms", "h2d_ms"}),
+    "stream_stall": frozenset({"epoch", "step", "wait_ms"}),
     # r17 warm-spare slices (cli._run_warm_spare) — append-only: one
     # record when a spare parks (event="parked") and one when it claims
     # a failed seat (event="claimed", with the adopted seat/slice/

@@ -73,7 +73,7 @@ class Trainer:
                  log: Callable[[str], None] = print,
                  state_shardings=None, resilience=None,
                  put_stacked: Optional[Callable] = None, resident=None,
-                 telemetry=None, profiler=None):
+                 telemetry=None, profiler=None, stream=None):
         self.cfg = cfg
         # telemetry.RunTelemetry bundle (or None = zero hot-path
         # overhead): per-dispatch JSONL records, span breakdown, epoch
@@ -100,6 +100,16 @@ class Trainer:
         # gathered inside the fused dispatch.  Eval stays on the host
         # path (once per epoch, off the hot loop).
         self.resident = resident
+        # beyond-HBM streaming source (data/stream/window.py
+        # DiskStreamSource, or None): the split lives on disk; each
+        # epoch trains through a double-buffered device window refilled
+        # by a background thread.  Steady-state stall accounting
+        # (fraction of step time blocked on data — bench's
+        # stream_stall_pct) accumulates here across epochs, excluding
+        # each program's compile-marked first dispatch.
+        self.stream = stream
+        self._stream_stall_s = 0.0
+        self._stream_wall_s = 0.0
         # K train steps per device dispatch (the fused lax.scan program);
         # 1 keeps the classic one-jit-call-per-step loop bit-for-bit.
         self.k = max(int(getattr(cfg, "steps_per_dispatch", 1) or 1), 1)
@@ -139,6 +149,12 @@ class Trainer:
         self.history: Dict[str, List[float]] = {
             "train_acc": [], "test_acc": [], "train_loss": [],
             "test_loss": [], "epoch_time": [], "peak_mem_bytes": []}
+        if getattr(cfg, "task", "cls") == "lm":
+            # the LM workload's headline metric rides the same history
+            # surface (train/metrics.perplexity of the token-weighted
+            # epoch loss); "accuracy" already IS next-token accuracy
+            self.history["train_ppl"] = []
+            self.history["test_ppl"] = []
         self.best_acc = 0.0
         self.recoveries = 0
         # host-side mirror of state.step: reading the device scalar per
@@ -168,8 +184,13 @@ class Trainer:
 
     def _fused_step(self, kk: int, resident=None) -> Callable:
         """Jitted K-step fused dispatch, cached per (path, kk) — an
-        epoch tail shorter than K compiles its own (one-off) program."""
-        key = ("resident" if resident is not None else "host", kk)
+        epoch tail shorter than K compiles its own (one-off) program.
+        The stream source duck-types the resident interface
+        (batch_major=True) and names its path via ``program_key`` so
+        the observatory's program table distinguishes
+        train:stream:kN from train:resident:kN."""
+        key = (getattr(resident, "program_key", "resident")
+               if resident is not None else "host", kk)
         fn = self._fused_cache.get(key)
         if fn is None:
             mesh = getattr(resident, "mesh", None)
@@ -200,10 +221,11 @@ class Trainer:
         per-epoch data/order arrays and warm naturally at catch-up
         (logged, not guessed around).  Returns how many programs were
         warmed."""
-        if self.resident is not None:
-            self.log("[spare] --data_path resident: the resident-gather "
-                     "programs are per-epoch-array-shaped and warm at "
-                     "catch-up; only the eval program warms now")
+        if self.resident is not None or self.stream is not None:
+            self.log("[spare] --data_path resident/stream: the in-graph-"
+                     "gather train programs take per-epoch/window data "
+                     "arrays and warm at catch-up; only the eval program "
+                     "warms now")
         donate = bool(self._donate)
 
         def _copy(st):
@@ -213,7 +235,7 @@ class Trainer:
                 lambda x: x.copy() if hasattr(x, "copy") else x, st)
 
         warmed = 0
-        if self.resident is None:
+        if self.resident is None and self.stream is None:
             loader = train_loader(0)
             it = iter(loader)
             try:
@@ -355,6 +377,8 @@ class Trainer:
 
     def run_epoch(self, state: TrainState, loader: Optional[Iterable],
                   epoch: int = 0, start_step: int = 0) -> tuple:
+        if self.stream is not None:
+            return self._run_epoch_stream(state, epoch, start_step)
         if self.resident is not None:
             return self._run_epoch_resident(state, epoch, start_step)
         if self.k > 1:
@@ -617,6 +641,111 @@ class Trainer:
         self._last_epoch_steps = n
         return state, acc.summary(), time.monotonic() - t0
 
+    def _run_epoch_stream(self, state: TrainState, epoch: int,
+                          start_step: int = 0) -> tuple:
+        """The beyond-HBM streaming loop: the split lives ON DISK
+        (data/stream/), only a fixed window of batches is device-
+        resident, and a background thread refills the next buffer
+        (disk mmap gather + H2D) while this loop trains the current one
+        — each dispatch gathers batch ``n - base`` from the buffer
+        in-graph, the sharded-resident batch-major idiom on a
+        window-deep leading axis.
+
+        Mid-epoch resume is a pure SEEK (the refill stream just starts
+        at ``start_step``; batch content is a pure function of
+        (seed, epoch, batch index)).  The window is CLOSED on every
+        exit, normal or abnormal — PrefetchIterator's cancel/drain
+        lifecycle reclaims the refill thread exactly like the host
+        loader's prefetch worker.  Host-iterator fault injection
+        (FDT_FAULT_DATA_AT_BATCH) does not apply (no host iterator to
+        wrap — the resident path's precedent); step faults and
+        preemption inject as everywhere.
+
+        Timing note: the two clock reads bracketing ``buffer_for`` are
+        UNCONDITIONAL (unlike the host paths' --telemetry_every-gated
+        reads) — the swap wait is the stream-stall metric itself and
+        must be measured regardless of whether the step record is kept;
+        K>1 amortizes them like every other per-dispatch cost."""
+        src = self.stream
+        acc = MetricAccumulator()
+        t0 = time.monotonic()
+        metrics = None
+        res = self.resilience
+        n_steps = src.steps_per_epoch
+        if start_step:
+            self.log(f"[resume] epoch {epoch}: stream seek to batch "
+                     f"{start_step} (window refills start there; no "
+                     f"host replay)")
+        window = src.epoch_window(epoch, start_step)
+        n = start_step
+        last = (t0, start_step)
+        self._blocked_since_log = 0.0
+        # the epoch-INITIAL buffer fill is un-overlapped by construction
+        # (nothing trains while the first window loads) — exclude that
+        # one wait from the steady-state stall accounting on every
+        # epoch, the same way compile-carrying first dispatches are
+        epoch_cold = True
+        try:
+            while n < n_steps:
+                t_rec = time.monotonic()
+                base, hi, data = window.buffer_for(n)
+                t_disp = time.monotonic()
+                kk = min(self.k, n_steps - n, hi - n)
+                key = ("stream", kk)
+                first = key not in self._dispatched
+                want = first or self._keep_dispatch_times(key)
+                self._prof_before(kk)
+                state, metrics = self._fused_step(kk, src)(
+                    state, data, src.dummy_order,
+                    jax.numpy.asarray(n - base, jax.numpy.int32))
+                t_done = time.monotonic()
+                acc.add(metrics)
+                n += kk
+                self.global_step += kk
+                if self._sharding_expect is None:
+                    self._observe_state_placement(state)
+                self._prof_after(metrics)
+                t_step = time.monotonic()
+                if res is not None:
+                    state = self._resilience_hooks(state, epoch, n,
+                                                   n_steps=kk)
+                t_end = time.monotonic()
+                self._blocked_since_log += t_end - t_done
+                if not first and not epoch_cold:
+                    # steady-state stall accounting for stream_stall_pct:
+                    # compile-carrying first dispatches AND each epoch's
+                    # cold initial fill excluded (telemetry-percentile
+                    # rule); the denominator stops BEFORE the resilience
+                    # hooks — checkpoint/rendezvous time has its own
+                    # overhead metric and must not dilute "fraction of
+                    # STEP time blocked on data"
+                    self._stream_stall_s += t_disp - t_rec
+                    self._stream_wall_s += t_step - t_rec
+                epoch_cold = False
+                self._record_dispatch(
+                    epoch, n, kk, t_end - t_rec if want else 0.0,
+                    t_done - t_disp if want else 0.0,
+                    t_disp - t_rec if want else 0.0,
+                    t_end - t_done, key)
+                last = self._log_dispatch(epoch, n, kk, metrics, last)
+        finally:
+            # normal AND abnormal exits reclaim the refill thread (the
+            # prefetch-closer contract the host paths honor in except:)
+            window.close()
+        if metrics is not None:
+            float(metrics["loss"])     # fence (see run_epoch)
+        self._last_epoch_steps = n
+        return state, acc.summary(), time.monotonic() - t0
+
+    @property
+    def stream_stall_pct(self) -> Optional[float]:
+        """Steady-state fraction (percent) of streamed step time spent
+        blocked on the data window — the run-level number bench/smoke
+        read (None before any steady-state streamed dispatch)."""
+        if self.stream is None or self._stream_wall_s <= 0:
+            return None
+        return 100.0 * self._stream_stall_s / self._stream_wall_s
+
     def _resilience_hooks(self, state: TrainState, epoch: int,
                           step_in_epoch: int, n_steps: int = 1
                           ) -> TrainState:
@@ -803,7 +932,8 @@ class Trainer:
             with watch:
                 state, train_m, elapsed = self.run_epoch(
                     state,
-                    None if self.resident is not None
+                    None if (self.resident is not None
+                             or self.stream is not None)
                     else train_loader(epoch),
                     epoch, start_step=resume_step)
             resumed_mid_epoch, resume_step = resume_step, 0
@@ -882,6 +1012,19 @@ class Trainer:
             if cfg.debug:
                 self._debug_checks(state, epoch)
             test_m = self.evaluate(state, eval_loader(epoch))
+            if getattr(cfg, "task", "cls") == "lm":
+                # LM headline: perplexity of the exact token-weighted
+                # epoch loss (train/metrics.perplexity), train and eval
+                from faster_distributed_training_tpu.train.metrics import (
+                    perplexity)
+                if _finite(train_m.get("loss")):
+                    train_m["perplexity"] = perplexity(train_m["loss"])
+                if _finite(test_m.get("loss")):
+                    test_m["perplexity"] = perplexity(test_m["loss"])
+                self.history["train_ppl"].append(
+                    train_m.get("perplexity", 0.0))
+                self.history["test_ppl"].append(
+                    test_m.get("perplexity", 0.0))
             self.history["train_acc"].append(train_m.get("accuracy", 0.0))
             self.history["train_loss"].append(train_m.get("loss", 0.0))
             self.history["test_acc"].append(test_m.get("accuracy", 0.0))
@@ -899,6 +1042,8 @@ class Trainer:
                 f"test_loss={test_m.get('loss', 0):.4f} "
                 f"test_acc={test_m.get('accuracy', 0):.4f} "
                 f"time={elapsed:.1f}s"
+                + (f" test_ppl={test_m['perplexity']:.2f}"
+                   if "perplexity" in test_m else "")
                 + (f" peak_mem={peak / 1e6:.0f}MB" if peak else ""))
             # best-acc-gated full-state checkpoint (resnet50_test.py:663-675)
             if test_m.get("accuracy", 0.0) > self.best_acc:
@@ -922,6 +1067,10 @@ class Trainer:
                     ev["eval_loss"] = test_m["loss"]
                 if "accuracy" in test_m:
                     ev["eval_accuracy"] = test_m["accuracy"]
+                if "perplexity" in train_m:
+                    ev["perplexity"] = train_m["perplexity"]
+                if "perplexity" in test_m:
+                    ev["eval_perplexity"] = test_m["perplexity"]
                 if peak:
                     ev["peak_mem_bytes"] = int(peak)
                 rec.record_event("epoch", **ev)

@@ -36,6 +36,14 @@ def percentiles(values: Iterable[float],
     return out
 
 
+def perplexity(loss: float, cap: float = 30.0) -> float:
+    """exp of a per-token cross-entropy — the LM workload's headline
+    metric (--task lm).  The exponent is capped so an early-training /
+    diverged loss reports a large finite ppl instead of overflowing to
+    inf (exp(30) ~ 1e13 — unambiguous, still orderable)."""
+    return float(math.exp(min(float(loss), cap)))
+
+
 class MetricAccumulator:
     def __init__(self):
         self._sums: Dict[str, List[jax.Array]] = {}

@@ -16,7 +16,7 @@ on an ``fsdp`` axis the same code becomes ZeRO-3
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +43,37 @@ def resolve_mixup_mode(cfg: TrainConfig) -> str:
 
 def _tree_where(pred, a, b):
     return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def lm_shift_metrics(logits: jax.Array, tokens: jax.Array,
+                     tok_mask: Optional[jax.Array] = None,
+                     sample_valid: Optional[jax.Array] = None
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shifted next-token objective over ``logits [B, L, V]`` /
+    ``tokens [B, L]``: position t predicts token t+1.  Returns
+    ``(loss_total, correct, total)`` where ``total`` counts VALID target
+    positions — a target is valid when both its context position and the
+    target token itself are real (``tok_mask`` row-wise; packed LM rows
+    carry all-ones masks so every position counts), optionally crossed
+    with the per-SAMPLE ``valid`` mask of a padded final eval batch.
+    Per-token fp32 cross-entropy; the epoch summary recovers the exact
+    token-weighted loss from loss_total/total (MetricAccumulator), and
+    perplexity = exp(loss) rides on top (train/metrics.perplexity)."""
+    lg = logits[:, :-1].astype(jnp.float32)
+    tgt = tokens[:, 1:]
+    if tok_mask is not None:
+        valid = (tok_mask[:, :-1] * tok_mask[:, 1:]).astype(jnp.float32)
+    else:
+        valid = jnp.ones(tgt.shape, jnp.float32)
+    if sample_valid is not None:
+        valid = valid * sample_valid.astype(jnp.float32)[:, None]
+    import optax
+    losses = optax.softmax_cross_entropy_with_integer_labels(lg, tgt)
+    loss_total = jnp.sum(losses * valid)
+    correct = jnp.sum((jnp.argmax(lg, axis=-1) == tgt) * valid)
+    total = jnp.sum(valid)
+    return (loss_total.astype(jnp.float32), correct.astype(jnp.float32),
+            total.astype(jnp.float32))
 
 
 def _offload_transfers(state_shardings):
@@ -88,6 +119,11 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
     untouched."""
     fp16 = cfg.precision == "fp16"
     is_text = cfg.model == "transformer"
+    lm = getattr(cfg, "task", "cls") == "lm"
+    if lm and not is_text:
+        raise ValueError(f"--task lm needs the transformer (next-token "
+                         f"prediction over token ids); got model="
+                         f"{cfg.model!r}")
     mode = resolve_mixup_mode(cfg)
     # non-offload shardings (a tp/2D mesh): pin the UPDATED state to the
     # placement policy — without the constraint XLA's propagation is
@@ -135,6 +171,59 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
             # (ops.attention.dropout_keep).
             k_drop = jax.random.wrap_key_data(
                 jax.random.bits(k_drop, (4,), jnp.uint32), impl="rbg")
+        if lm:
+            # next-token LM objective (--task lm, r18): per-position
+            # vocab logits, targets = tokens shifted left.  mask=None to
+            # the model — the streamed LM rows are PACKED (format.
+            # pack_lm_rows: no padding), so there is nothing to mask in
+            # attention and the one program serves every data path
+            # identically; padded-target validity is handled in the LOSS
+            # (lm_shift_metrics' tok_mask term) for datasets that do pad.
+            # No mixup: a dense token objective has no sentence-embedding
+            # to mix (the k_mix rng is threaded for stream parity but
+            # the lm model path never draws from it).
+            def loss_fn(params):
+                variables = {"params": params["model"],
+                             "batch_stats": state.batch_stats}
+                logits, mutated = state.apply_fn(
+                    variables, batch["tokens"],
+                    token_types=batch.get("token_types"),
+                    mask=None, train=True,
+                    rngs={"dropout": k_drop, "mixup": k_mix},
+                    mutable=["batch_stats"])
+                loss_total, correct, total = lm_shift_metrics(
+                    logits, batch["tokens"], batch.get("mask"))
+                loss = loss_total / jnp.maximum(total, 1.0)
+                scaled = scale_loss(loss, state.loss_scale, fp16)
+                new_stats = mutated.get("batch_stats", state.batch_stats)
+                return scaled, (loss, loss_total, correct, total, new_stats)
+
+            grads, (loss, loss_total, correct, total, new_stats) = jax.grad(
+                loss_fn, has_aux=True)(state.params)
+            grads, finite = unscale_and_check(grads, state.loss_scale, fp16)
+            updated = state.apply_gradients(grads).replace(
+                batch_stats=new_stats,
+                loss_scale=update_loss_scale(state.loss_scale, finite,
+                                             fp16))
+            if fp16:
+                skipped = state.replace(
+                    step=state.step + 1,
+                    loss_scale=update_loss_scale(state.loss_scale, finite,
+                                                 fp16))
+                updated = _tree_where(finite, updated, skipped)
+            # loss = per-TOKEN mean (perplexity's log); total counts
+            # target tokens, so the accumulator's loss_total/total is
+            # the exact token-weighted epoch loss and "accuracy" is
+            # next-token accuracy
+            metrics = {"loss": loss.astype(jnp.float32),
+                       "loss_total": loss_total,
+                       "correct": correct, "total": total}
+            if fp16:
+                metrics["loss_scale"] = updated.loss_scale.scale
+            if constrain_out:
+                updated = jax.tree.map(jax.lax.with_sharding_constraint,
+                                       updated, state_shardings)
+            return stash(updated), metrics
         y = batch["label"]
 
         def loss_fn(params):
@@ -226,7 +315,12 @@ def _reduce_scanned_metrics(ms: Metrics) -> Metrics:
     log-line and the non-finite epoch check — any non-finite step
     poisons the mean, so divergence detection keeps per-step acuity."""
     out = {"loss": jnp.mean(ms["loss"]),
-           "loss_total": jnp.sum(ms["loss"] * ms["total"]),
+           # the LM step emits an exact loss_total (token-weighted sum);
+           # reduce it directly instead of re-deriving loss*total, so a
+           # K>1 LM dispatch's epoch loss is the same float the K=1
+           # path accumulates
+           "loss_total": (jnp.sum(ms["loss_total"]) if "loss_total" in ms
+                          else jnp.sum(ms["loss"] * ms["total"])),
            "correct": jnp.sum(ms["correct"]),
            "total": jnp.sum(ms["total"])}
     if "loss_scale" in ms:
@@ -331,10 +425,26 @@ def make_eval_step(cfg: TrainConfig) -> Callable[[TrainState, Any],
     state to device ONCE per eval epoch (Trainer.evaluate), not per batch —
     the state never changes inside an eval loop."""
     is_text = cfg.model == "transformer"
+    lm = getattr(cfg, "task", "cls") == "lm"
 
     def step(state: TrainState, batch: Dict[str, jax.Array]) -> Metrics:
         variables = {"params": state.params["model"],
                      "batch_stats": state.batch_stats}
+        if lm:
+            # next-token eval: same shifted objective as training, with
+            # the padded-final-batch per-sample `valid` mask crossed in
+            # (pad rows contribute zero target tokens — full-split
+            # perplexity is exact at any batch size)
+            logits = state.apply_fn(variables, batch["tokens"],
+                                    token_types=batch.get("token_types"),
+                                    mask=None, train=False)
+            loss_total, correct, total = lm_shift_metrics(
+                logits, batch["tokens"], batch.get("mask"),
+                batch.get("valid"))
+            return {"loss": (loss_total / jnp.maximum(total, 1.0)
+                             ).astype(jnp.float32),
+                    "loss_total": loss_total, "correct": correct,
+                    "total": total}
         if is_text:
             logits = state.apply_fn(variables, batch["tokens"],
                                     token_types=batch.get("token_types"),

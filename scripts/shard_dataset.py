@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Shard a corpus/split into the on-disk stream format (data/stream/).
+
+The writer half of the r18 streaming data plane: produces a
+``<out>/train`` + ``<out>/test`` pair of committed stream-format
+directories (raw per-leaf .npy shards + manifest.json written last)
+that ``--dataset stream --stream_dir <out>`` consumes on any data path
+(host / resident / streamed window).
+
+Text (the LM workload):
+    python scripts/shard_dataset.py --out /data/lm_corpus \\
+        --source agnews --seq_len 256 --rows_per_shard 4096
+  tokenizes the corpus through the agnews tokenizer ladder (HF when
+  cached -> WordPiece -> hash fallback), packs the token stream into
+  fixed [n, seq_len] rows (no padding — every position is a real
+  next-token target) and splits train/test at DOCUMENT granularity.
+  --source synthetic generates a deterministic pseudo-text corpus for
+  zero-egress environments.
+
+Images:
+    python scripts/shard_dataset.py --out /data/cifar_stream \\
+        --kind image --source cifar10
+  writes the (image uint8 NHWC, label int32) split pair as-is.
+
+Then:  python transformer_test.py --dataset stream --task lm \\
+           --data_path stream --stream_dir /data/lm_corpus
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", required=True,
+                   help="output root (train/ + test/ written under it)")
+    p.add_argument("--kind", default="text", choices=["text", "image"])
+    p.add_argument("--source", default="agnews",
+                   help="text: agnews | synthetic; image: cifar10 | "
+                        "synthetic")
+    p.add_argument("--seq_len", default=256, type=int,
+                   help="packed LM row length (text)")
+    p.add_argument("--rows_per_shard", default=4096, type=int)
+    p.add_argument("--val_fraction", default=0.1, type=float,
+                   help="document fraction held out as the test split "
+                        "(text)")
+    p.add_argument("--data_dir", default="./data",
+                   help="where the source corpus lives / downloads")
+    p.add_argument("--n_docs", default=4096, type=int,
+                   help="synthetic text: corpus size in documents")
+    p.add_argument("--n", default=8192, type=int,
+                   help="synthetic image: train split size")
+    p.add_argument("--seed", default=0, type=int)
+    args = p.parse_args(argv)
+
+    from faster_distributed_training_tpu.data.stream import (
+        synthetic_corpus, write_array_dataset, write_lm_corpus)
+
+    if args.kind == "text":
+        if args.source == "agnews":
+            from faster_distributed_training_tpu.data.agnews import (
+                AGNewsDataset)
+            try:
+                ds = AGNewsDataset(args.data_dir, train=True)
+                # samples are already cleaned by the dataset loader
+                texts = [t for t, _ in ds.samples]
+                tokenizer, clean = ds.tokenizer, False
+            except FileNotFoundError as e:
+                print(f"[shard] AG News unavailable ({e}); using the "
+                      f"synthetic corpus")
+                texts = synthetic_corpus(args.n_docs, seed=args.seed)
+                tokenizer, clean = None, True
+        else:
+            texts = synthetic_corpus(args.n_docs, seed=args.seed)
+            tokenizer, clean = None, True
+        out = write_lm_corpus(args.out, texts, args.seq_len,
+                              tokenizer=tokenizer, data_dir=args.data_dir,
+                              val_fraction=args.val_fraction,
+                              rows_per_shard=args.rows_per_shard,
+                              seed=args.seed, clean=clean)
+        print(f"[shard] LM corpus -> {args.out}: "
+              f"train {out['train']['n']} x {args.seq_len} rows "
+              f"({len(out['train']['shards'])} shard(s)), "
+              f"test {out['test']['n']} rows, vocab {out['vocab_size']}")
+        return 0
+
+    if args.source == "cifar10":
+        from faster_distributed_training_tpu.data.cifar10 import load_cifar10
+        splits = {s: load_cifar10(args.data_dir, train=(s == "train"))
+                  for s in ("train", "test")}
+    else:
+        from faster_distributed_training_tpu.data.synthetic import (
+            synthetic_cifar)
+        splits = {"train": synthetic_cifar(args.n, seed=args.seed),
+                  "test": synthetic_cifar(max(args.n // 4, 1),
+                                          seed=args.seed + 1)}
+    for split, (x, y) in splits.items():
+        man = write_array_dataset(
+            os.path.join(args.out, split), {"image": x, "label": y},
+            rows_per_shard=args.rows_per_shard,
+            meta={"content": "image", "num_classes": 10, "split": split})
+        print(f"[shard] image {split} -> {args.out}/{split}: {man['n']} "
+              f"rows, {len(man['shards'])} shard(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

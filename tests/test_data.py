@@ -306,6 +306,72 @@ class TestDownloadInfra:
         with pytest.raises(ValueError, match="not a PFM"):
             dl.read_pfm(str(bad))
 
+    def test_retry_recovers_from_flaky_fetcher(self, tmp_path):
+        """r18 hardening: a transient network failure (or a truncated
+        transfer caught by the checksum) must be retried with backoff
+        instead of failing the run outright — injected failing fetcher,
+        injected sleep (no real waiting)."""
+        import hashlib
+        import urllib.error
+        payload = b"the real archive bytes"
+        sha = hashlib.sha256(payload).hexdigest()
+        calls, naps = [], []
+
+        def flaky(url, path):
+            calls.append(url)
+            if len(calls) == 1:                 # mid-body disconnect:
+                with open(path, "wb") as f:     # partial file + the
+                    f.write(payload[:3])        # http-layer exception
+                import http.client
+                raise http.client.IncompleteRead(payload[:3])
+            if len(calls) == 2:                 # truncated transfer
+                with open(path, "wb") as f:
+                    f.write(payload[:5])
+                return
+            with open(path, "wb") as f:
+                f.write(payload)
+
+        got = dl.download_url("http://example.invalid/a.bin", str(tmp_path),
+                              sha256=sha, attempts=3, backoff_s=0.5,
+                              fetch=flaky, sleep=naps.append)
+        assert len(calls) == 3
+        assert naps == [0.5, 1.0]               # exponential backoff
+        assert open(got, "rb").read() == payload
+        # and the verified file short-circuits the next call entirely
+        dl.download_url("http://example.invalid/a.bin", str(tmp_path),
+                        sha256=sha, attempts=1,
+                        fetch=lambda *a: (_ for _ in ()).throw(
+                            AssertionError("refetched a verified file")))
+
+    def test_retry_budget_exhausts_without_partial_file(self, tmp_path):
+        import urllib.error
+        naps = []
+
+        def always_torn(url, path):
+            with open(path, "wb") as f:
+                f.write(b"garbage")
+            raise urllib.error.URLError("mid-transfer drop")
+
+        with pytest.raises(RuntimeError, match="after 3 attempt"):
+            dl.download_url("http://example.invalid/b.bin", str(tmp_path),
+                            attempts=3, fetch=always_torn,
+                            sleep=naps.append)
+        # every failed attempt deleted its partial file — a torn archive
+        # can never be cached as the dataset
+        assert not (tmp_path / "b.bin").exists()
+        assert len(naps) == 2
+
+    def test_persistent_checksum_mismatch_surfaces(self, tmp_path):
+        def wrong_bytes(url, path):
+            with open(path, "wb") as f:
+                f.write(b"not the expected upstream file")
+
+        with pytest.raises(RuntimeError, match="sha256 mismatch"):
+            dl.download_url("http://example.invalid/c.bin", str(tmp_path),
+                            sha256="0" * 64, attempts=2, fetch=wrong_bytes,
+                            sleep=lambda _s: None)
+        assert not (tmp_path / "c.bin").exists()
+
     def test_google_drive_offline_fails_clearly(self, tmp_path, monkeypatch):
         import urllib.error
         import urllib.request

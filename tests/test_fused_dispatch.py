@@ -481,6 +481,8 @@ class TestPrefetchClose:
         trainer.cfg = cfg
         trainer.resilience = None
         trainer.resident = None
+        trainer.stream = None                # r18 streaming attr the
+                                             # epoch router reads
         trainer.k = 1
         trainer.put_batch = lambda b: b
         trainer.global_step = 0

@@ -1,0 +1,496 @@
+"""Streaming data plane tests (data/stream/, r18): the on-disk sharded
+format (writer commit marker, mmap reader integrity checks), the
+windowed refill's byte-equality against ``pod_epoch_order``'s pure
+algebra across (process_count, local_bs) grids, the cancel/drain
+window lifecycle, the next-token LM objective (shifted loss /
+perplexity / lm_head), and the e2e bitwise pins: a streamed run equals
+the resident reference, and a kill-at-N MID-WINDOW resume equals the
+uninterrupted streamed run.  All CPU, single-process, tier-1.
+
+The process-level twin (fresh-process resume, nothing shared but the
+shards + checkpoint dir) is scripts/stream_smoke.py, wrapped in-process
+at the bottom of this file."""
+
+import json
+import os
+import threading
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from faster_distributed_training_tpu.config import TrainConfig
+from faster_distributed_training_tpu.data.loader import pod_epoch_order
+from faster_distributed_training_tpu.data.stream import (
+    DiskStreamSource, ShardedStreamDataset, pack_lm_rows, synthetic_corpus,
+    write_array_dataset, write_lm_corpus, write_stream_dataset)
+from faster_distributed_training_tpu.data.synthetic import synthetic_cifar
+
+
+def _assert_tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- fixtures: one tiny image split + one tiny LM corpus, shared ----------
+
+@pytest.fixture(scope="module")
+def image_stream(tmp_path_factory):
+    """96-sample CIFAR-shaped split sharded at rows_per_shard=25 — four
+    shards, the last partial, so every gather/window test crosses shard
+    boundaries."""
+    x, y = synthetic_cifar(96, seed=3)
+    d = str(tmp_path_factory.mktemp("img_stream"))
+    man = write_array_dataset(d, {"image": x, "label": y}, rows_per_shard=25)
+    return d, x, y, man
+
+
+@pytest.fixture(scope="module")
+def lm_corpus(tmp_path_factory):
+    """A small synthetic-text corpus sharded for the LM workload:
+    seq_len=16 packed rows, multiple shards, train/test doc split."""
+    d = str(tmp_path_factory.mktemp("lm_stream"))
+    texts = synthetic_corpus(40, seed=3, words_per_doc=(25, 50))
+    out = write_lm_corpus(d, texts, seq_len=16, rows_per_shard=16,
+                          val_fraction=0.15)
+    train = ShardedStreamDataset(os.path.join(d, "train"))
+    assert len(train.manifest["shards"]) > 1     # multi-shard, by design
+    return d, train, out
+
+
+# -- the at-rest format ---------------------------------------------------
+
+class TestStreamFormat:
+    def test_multichunk_write_read_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(0)
+        chunks = [{"a": rng.integers(0, 99, (n, 3)).astype(np.int32),
+                   "b": rng.random((n,)).astype(np.float32)}
+                  for n in (7, 12, 5)]
+        man = write_stream_dataset(str(tmp_path / "ds"), iter(chunks),
+                                   rows_per_shard=10)
+        ref = {k: np.concatenate([c[k] for c in chunks]) for k in ("a", "b")}
+        ds = ShardedStreamDataset(str(tmp_path / "ds"))
+        assert ds.n == 24 and man["n"] == 24
+        assert [s["rows"] for s in man["shards"]] == [10, 10, 4]
+        got = ds.gather(np.arange(24))
+        np.testing.assert_array_equal(got["a"], ref["a"])
+        np.testing.assert_array_equal(got["b"], ref["b"])
+        # any order, repeats allowed, crossing shard boundaries
+        idx = np.array([23, 0, 9, 10, 9, 15])
+        got = ds.gather(idx)
+        np.testing.assert_array_equal(got["a"], ref["a"][idx])
+        assert ds.row_bytes() == 3 * 4 + 4
+        with pytest.raises(IndexError):
+            ds.gather([24])
+
+    def test_manifest_is_the_commit_marker(self, image_stream, tmp_path):
+        import shutil
+        d, *_ = image_stream
+        torn = tmp_path / "torn"
+        shutil.copytree(d, torn)
+        os.remove(torn / "manifest.json")
+        with pytest.raises(FileNotFoundError, match="not a committed"):
+            ShardedStreamDataset(str(torn))
+
+    def test_truncated_shard_detected_at_open(self, image_stream, tmp_path):
+        import shutil
+        d, *_ = image_stream
+        torn = tmp_path / "trunc"
+        shutil.copytree(d, torn)
+        victim = sorted(torn.glob("shard_*.image.npy"))[1]
+        victim.write_bytes(victim.read_bytes()[:-100])
+        with pytest.raises(ValueError, match="truncated/torn"):
+            ShardedStreamDataset(str(torn))
+
+    def test_reinterpreted_shard_dtype_detected(self, image_stream,
+                                                tmp_path):
+        """A NON-final shard rewritten with the same byte size but a
+        different dtype must fail at open (the per-shard header check),
+        not gather as reinterpreted garbage mid-epoch."""
+        import shutil
+        d, *_ = image_stream
+        torn = tmp_path / "dtype"
+        shutil.copytree(d, torn)
+        victim = sorted(torn.glob("shard_*.label.npy"))[1]
+        arr = np.load(victim)
+        before = victim.stat().st_size
+        np.save(victim, arr.astype(np.float32))   # int32 -> float32
+        assert victim.stat().st_size == before    # size check can't catch it
+        with pytest.raises(ValueError, match="manifest says"):
+            ShardedStreamDataset(str(torn))
+
+    def test_writer_rejects_bad_chunks(self, tmp_path):
+        with pytest.raises(ValueError, match="empty chunk"):
+            write_stream_dataset(str(tmp_path / "e"), [])
+        bad = [{"a": np.zeros((4, 2), np.int32)},
+               {"a": np.zeros((4, 3), np.int32)}]       # shape drift
+        with pytest.raises(ValueError, match="leaf spec"):
+            write_stream_dataset(str(tmp_path / "s"), bad)
+        with pytest.raises(ValueError, match="disagree on row count"):
+            write_stream_dataset(str(tmp_path / "r"),
+                                 [{"a": np.zeros(4), "b": np.zeros(5)}])
+
+    def test_pack_lm_rows_is_the_concatenated_stream(self):
+        class Tok:
+            def encode(self, text, truncation=True, max_length=0):
+                return [len(w) + 100 for w in text.split()]
+
+        texts = [f"{'x ' * k}end" for k in (5, 9, 2, 14, 7)]
+        tok = Tok()
+        rows = np.concatenate([c["tokens"] for c in
+                               pack_lm_rows(texts, tok, seq_len=8,
+                                            chunk_docs=2)])
+        stream = [t for doc in texts for t in tok.encode(doc)]
+        full = len(stream) // 8
+        ref = np.asarray(stream[:full * 8], np.int32).reshape(full, 8)
+        np.testing.assert_array_equal(rows, ref)   # trailing partial dropped
+
+
+# -- window refill byte-equality vs pod_epoch_order (ISSUE satellite) -----
+
+class TestWindowByteEquality:
+    """The streamed window's batch stream must be byte-equal to the
+    ``pod_epoch_order`` materialization the resident paths gather — for
+    single-host AND simulated pod (pc, lbs) layouts, at every window
+    position including the short tail."""
+
+    @pytest.mark.parametrize("pc", [1, 2, 4])
+    def test_image_host_buffers_match_epoch_order(self, image_stream, pc):
+        d, x, y, _man = image_stream
+        bs, lbs = 8, 8 // pc
+        ds = ShardedStreamDataset(d)
+        srcs = [DiskStreamSource(ds, bs, seed=5, window_batches=5,
+                                 process_index=pi, process_count=pc)
+                for pi in range(pc)]
+        steps = srcs[0].steps_per_epoch
+        assert steps == 96 // 8
+        for epoch in (0, 1):
+            order = srcs[0].epoch_order(epoch)
+            np.testing.assert_array_equal(
+                order, pod_epoch_order(96, epoch, 5, True, pc, lbs))
+            for base in range(0, steps, 5):       # includes the short tail
+                hi = min(base + 5, steps)
+                bufs = [s.host_buffer(order, base, hi) for s in srcs]
+                for b in range(base, hi):
+                    # reassemble global batch b process-major from the
+                    # per-host buffers; compare vs the flat order slice
+                    glob = np.concatenate(
+                        [buf["image"][b - base] for buf in bufs])
+                    np.testing.assert_array_equal(
+                        glob, x[order[b * bs:(b + 1) * bs]])
+                    glob_y = np.concatenate(
+                        [buf["label"][b - base] for buf in bufs])
+                    np.testing.assert_array_equal(
+                        glob_y, y[order[b * bs:(b + 1) * bs]])
+                if hi - base < 5:                 # zeroed, never-consumed tail
+                    assert not bufs[0]["image"][hi - base:].any()
+
+    def test_text_host_buffer_matches_encode_batch(self, lm_corpus):
+        _d, train, _out = lm_corpus
+        pc, bs = 2, 8
+        order = pod_epoch_order(train.n, 1, 0, True, pc, bs // pc)
+        for pi in range(pc):
+            src = DiskStreamSource(train, bs, seed=0, window_batches=3,
+                                   process_index=pi, process_count=pc,
+                                   max_len=16)
+            buf = src.host_buffer(src.epoch_order(1), 0, 3)
+            assert sorted(buf) == ["label", "mask", "token_types", "tokens"]
+            idx = order.reshape(-1, pc, bs // pc)[0:3, pi]
+            ref = train.encode_batch(idx.reshape(-1), 16)
+            for k in ref:
+                np.testing.assert_array_equal(
+                    buf[k].reshape((-1,) + buf[k].shape[2:]), ref[k])
+
+
+# -- window lifecycle: refill, seek, cancel/drain -------------------------
+
+class TestWindowLifecycle:
+    def test_refill_stream_serves_the_epoch_and_seeks(self, image_stream):
+        d, x, _y, _man = image_stream
+        src = DiskStreamSource(ShardedStreamDataset(d), 8, seed=5,
+                               window_batches=4)
+        order = src.epoch_order(0)
+        win = src.epoch_window(0)
+        try:
+            for n in range(src.steps_per_epoch):
+                base, hi, dev = win.buffer_for(n)
+                assert base <= n < hi
+                np.testing.assert_array_equal(
+                    np.asarray(dev["image"][n - base]),
+                    x[order.reshape(-1, 8)[n]])
+        finally:
+            win.close()
+        # mid-epoch resume is a pure seek: the stream restarts at
+        # start_step and serves the same bytes the full stream did there
+        seek = src.epoch_window(0, start_step=9)
+        try:
+            base, hi, dev = seek.buffer_for(9)
+            assert base == 9
+            np.testing.assert_array_equal(
+                np.asarray(dev["image"][0]), x[order.reshape(-1, 8)[9]])
+        finally:
+            seek.close()
+
+    def test_close_reclaims_refill_thread_on_abnormal_exit(self,
+                                                          image_stream):
+        """The cancel/drain satellite: an exception mid-epoch must leave
+        no refill thread alive or blocked on a full queue."""
+        d, *_ = image_stream
+        src = DiskStreamSource(ShardedStreamDataset(d), 8, seed=5,
+                               window_batches=2)
+        before = threading.active_count()
+        win = src.epoch_window(0)
+        with pytest.raises(RuntimeError, match="injected"):
+            try:
+                win.buffer_for(0)            # producer now mid-stream
+                raise RuntimeError("injected mid-epoch fault")
+            finally:
+                win.close()                  # the Trainer's finally: path
+        win._it._t.join(timeout=5)
+        assert not win._it._t.is_alive()
+        assert threading.active_count() <= before
+        win.close()                          # idempotent
+
+    def test_consumer_must_advance_monotonically(self, image_stream):
+        d, *_ = image_stream
+        src = DiskStreamSource(ShardedStreamDataset(d), 8, seed=5,
+                               window_batches=2)
+        win = src.epoch_window(0)
+        try:
+            win.buffer_for(0)
+            with pytest.raises(RuntimeError, match="skew"):
+                win.buffer_for(7)            # skipped a whole buffer
+        finally:
+            win.close()
+        tail = src.epoch_window(0, start_step=10)
+        try:
+            tail.buffer_for(10)
+            with pytest.raises(RuntimeError, match="exhausted"):
+                tail.buffer_for(src.steps_per_epoch)
+        finally:
+            tail.close()
+
+    def test_window_rounds_up_to_dispatch_multiple(self, image_stream):
+        d, *_ = image_stream
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            src = DiskStreamSource(ShardedStreamDataset(d), 8,
+                                   window_batches=3, steps_per_dispatch=2)
+        assert src.window == 4
+        assert any("dispatch-aligned" in str(x.message) for x in w)
+
+    def test_undersized_dataset_rejected(self, image_stream):
+        d, *_ = image_stream
+        with pytest.raises(ValueError, match="nothing to train on"):
+            DiskStreamSource(ShardedStreamDataset(d), 128,
+                             process_count=2, process_index=0)
+
+
+class TestLazyImageAdapter:
+    """open_stream_split's image flavor must NOT materialize a
+    multi-shard split in host RAM: the (image, label) pair is a lazy
+    per-shard-mmap view that the array pipelines consume like ndarrays
+    (fancy rows, strided slices, asarray, len)."""
+
+    def test_lazy_view_matches_source_rows(self, image_stream, tmp_path):
+        from faster_distributed_training_tpu.data.stream.reader import (
+            _LazyShardRows, open_stream_split)
+        d, x, y, _man = image_stream
+        os.makedirs(tmp_path / "root", exist_ok=True)
+        os.symlink(d, tmp_path / "root" / "train")
+        img, lab = open_stream_split(str(tmp_path / "root"), train=True)
+        assert isinstance(img, _LazyShardRows)      # multi-shard = lazy
+        assert len(img) == 96 and img.shape == x.shape
+        idx = np.array([95, 0, 24, 25, 24, 60])     # shard-crossing
+        np.testing.assert_array_equal(img[idx], x[idx])
+        np.testing.assert_array_equal(lab[idx], y[idx])
+        np.testing.assert_array_equal(img[::7], x[::7])   # apply_subset
+        np.testing.assert_array_equal(img[3], x[3])
+        np.testing.assert_array_equal(np.asarray(img), x)  # resident path
+
+    def test_batchloader_over_lazy_equals_arrays(self, image_stream,
+                                                 tmp_path):
+        from faster_distributed_training_tpu.data import BatchLoader
+        from faster_distributed_training_tpu.data.stream.reader import (
+            open_stream_split)
+        d, x, y, _man = image_stream
+        os.makedirs(tmp_path / "root", exist_ok=True)
+        os.symlink(d, tmp_path / "root" / "train")
+        lazy = open_stream_split(str(tmp_path / "root"), train=True)
+        for a, b in zip(BatchLoader(lazy, 16, epoch=1, seed=4,
+                                    process_index=0, process_count=1),
+                        BatchLoader((x, y), 16, epoch=1, seed=4,
+                                    process_index=0, process_count=1)):
+            np.testing.assert_array_equal(a["image"], b["image"])
+            np.testing.assert_array_equal(a["label"], b["label"])
+
+
+# -- the next-token LM objective ------------------------------------------
+
+class TestLMObjective:
+    def test_lm_shift_metrics_matches_numpy_reference(self):
+        from faster_distributed_training_tpu.train.steps import (
+            lm_shift_metrics)
+        rng = np.random.default_rng(4)
+        B, L, V = 3, 6, 11
+        logits = rng.standard_normal((B, L, V)).astype(np.float32)
+        tokens = rng.integers(0, V, (B, L)).astype(np.int32)
+        mask = np.ones((B, L), np.float32)
+        mask[1, 4:] = 0.0                      # a padded row tail
+        sample_valid = np.array([1.0, 1.0, 0.0], np.float32)  # a pad row
+        lt, corr, tot = lm_shift_metrics(jnp.asarray(logits),
+                                         jnp.asarray(tokens),
+                                         jnp.asarray(mask),
+                                         jnp.asarray(sample_valid))
+        # numpy reference: target t+1 from position t, both real, row valid
+        lg, tgt = logits[:, :-1], tokens[:, 1:]
+        valid = (mask[:, :-1] * mask[:, 1:]) * sample_valid[:, None]
+        z = lg - lg.max(-1, keepdims=True)
+        lse = np.log(np.exp(z).sum(-1)) + lg.max(-1)
+        ce = lse - np.take_along_axis(lg, tgt[..., None], -1)[..., 0]
+        assert float(tot) == valid.sum() == 5 + 3   # rows 0 and 1 only
+        np.testing.assert_allclose(float(lt), (ce * valid).sum(), rtol=1e-5)
+        np.testing.assert_array_equal(
+            float(corr), ((lg.argmax(-1) == tgt) * valid).sum())
+
+    def test_perplexity_is_capped_exp(self):
+        import math
+        from faster_distributed_training_tpu.train.metrics import perplexity
+        assert perplexity(1.0) == pytest.approx(math.e)
+        assert perplexity(1e9) == pytest.approx(math.exp(30.0))
+
+    def test_lm_head_emits_per_position_vocab_logits(self):
+        from faster_distributed_training_tpu.cli import build_model
+        cfg = TrainConfig(model="transformer", task="lm", seq_len=12,
+                          n_layers=1, d_model=16, d_ff=32, n_heads=2)
+        model = build_model(cfg, vocab_size=50)
+        tokens = jnp.ones((2, 12), jnp.int32)
+        vs = model.init(jax.random.PRNGKey(0), tokens, train=False)
+        out = model.apply(vs, tokens, train=False)
+        assert out.shape == (2, 12, 50) and out.dtype == jnp.float32
+
+    def test_lm_requires_the_transformer(self):
+        from faster_distributed_training_tpu.train.steps import (
+            make_train_step)
+        with pytest.raises(ValueError, match="transformer"):
+            make_train_step(TrainConfig(model="resnet18", task="lm"))
+
+
+# -- e2e: streamed training bitwise vs resident; kill-at-N resume ---------
+
+def _lm_cfg(stream_dir, ckpt, **kw):
+    base = dict(model="transformer", dataset="stream", task="lm",
+                data_path="stream", stream_dir=stream_dir,
+                batch_size=8, seq_len=16, n_layers=1, d_model=16,
+                d_ff=32, n_heads=2, epochs=2, steps_per_dispatch=2,
+                stream_window=4, optimizer="sgd", precision="fp32",
+                plot=False, workers=0, log_every=0, donate=False,
+                checkpoint_dir=str(ckpt))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+class TestStreamTrainingE2E:
+    """ISSUE acceptance: the streamed LM run reproduces the resident
+    reference bitwise, and a mid-WINDOW kill + in-process supervisor
+    resume lands bitwise on the uninterrupted streamed run."""
+
+    @pytest.fixture(scope="class")
+    def streamed_ref(self, lm_corpus, tmp_path_factory):
+        from faster_distributed_training_tpu.cli import run_training
+        d, train, _out = lm_corpus
+        assert train.n // 8 >= 7        # room for a mid-epoch kill below
+        out = run_training(
+            _lm_cfg(d, tmp_path_factory.mktemp("stream_ref")),
+            log=lambda *_: None)
+        return out, train
+
+    def test_streamed_run_trains_the_lm_workload(self, streamed_ref):
+        out, train = streamed_ref
+        steps = (train.n // 8) * 2
+        assert int(out["state"].step) == steps
+        assert out["history"]["test_ppl"] and out["history"]["test_ppl"][-1] > 1.0
+        assert "stream_stall_pct" in out      # run-level stall accounting
+
+    def test_streamed_telemetry_records_refills(self, streamed_ref):
+        out, _train = streamed_ref
+        jsonl = os.path.join(out["telemetry_dir"], "host_00000.jsonl")
+        kinds = [json.loads(l)["kind"] for l in open(jsonl)]
+        assert "stream_refill" in kinds
+        ev = next(json.loads(l) for l in open(jsonl)
+                  if json.loads(l)["kind"] == "stream_refill")
+        assert {"epoch", "base", "batches", "bytes", "read_ms",
+                "h2d_ms"} <= set(ev)
+
+    def test_resident_reference_is_bitwise_equal(self, streamed_ref,
+                                                 tmp_path):
+        """Same on-disk dataset, same (seed, epoch, step) algebra,
+        entirely different input machinery (whole split uploaded once
+        vs disk-windowed refill) — params/opt_state/rng must agree
+        bitwise."""
+        from faster_distributed_training_tpu.cli import run_training
+        out, _train = streamed_ref
+        res = run_training(
+            _lm_cfg(out["cfg"].stream_dir, tmp_path,
+                    data_path="resident"),
+            log=lambda *_: None)
+        assert int(res["state"].step) == int(out["state"].step)
+        _assert_tree_equal(res["state"].params, out["state"].params)
+        _assert_tree_equal(res["state"].opt_state, out["state"].opt_state)
+        np.testing.assert_array_equal(np.asarray(res["state"].rng),
+                                      np.asarray(out["state"].rng))
+
+    def test_lm_corpus_rejects_cls_task(self, streamed_ref, tmp_path):
+        """Forgetting --task lm on an LM-content corpus must fail loudly
+        — the reader's zero placeholder labels would otherwise train a
+        'perfect' constant classifier silently."""
+        from faster_distributed_training_tpu.cli import run_training
+        out, _train = streamed_ref
+        with pytest.raises(ValueError, match="--task lm"):
+            run_training(_lm_cfg(out["cfg"].stream_dir, tmp_path,
+                                 task="cls"),
+                         log=lambda *_: None)
+
+    def test_killed_mid_window_resumes_bitwise(self, streamed_ref,
+                                               tmp_path, monkeypatch):
+        """Kill INSIDE a window (step 6 of window [4, 8)), supervisor
+        restores the cadence checkpoint, the resume SEEKS into the same
+        global batch stream — final state bitwise vs uninterrupted."""
+        from faster_distributed_training_tpu.cli import run_training
+        from faster_distributed_training_tpu.resilience import faults
+        out, _train = streamed_ref
+        monkeypatch.setenv(faults.ENV_DIE, "6")
+        got = run_training(
+            _lm_cfg(out["cfg"].stream_dir, tmp_path, supervise=True,
+                    checkpoint_every=4),
+            log=lambda *_: None)
+        assert got["goodput_restarts"] == 1
+        assert int(got["state"].step) == int(out["state"].step)
+        _assert_tree_equal(got["state"].params, out["state"].params)
+        _assert_tree_equal(got["state"].opt_state, out["state"].opt_state)
+        np.testing.assert_array_equal(np.asarray(got["state"].rng),
+                                      np.asarray(out["state"].rng))
+
+
+# -- the process-level smoke, in-process (tier-1 acceptance) --------------
+
+def test_stream_smoke_in_process(monkeypatch):
+    """scripts/stream_smoke.py end-to-end: shard → streamed reference →
+    kill mid-window → FRESH-PROCESS resume → digest equality.  Env
+    passes conftest's numeric config through to the subprocess children
+    (the pod_restart smoke wrapper's contract)."""
+    import importlib.util
+
+    monkeypatch.setenv("JAX_ENABLE_X64", str(int(jax.config.jax_enable_x64)))
+    monkeypatch.setenv("JAX_THREEFRY_PARTITIONABLE",
+                       str(int(jax.config.jax_threefry_partitionable)))
+    spec = importlib.util.spec_from_file_location(
+        "stream_smoke", os.path.join(os.path.dirname(__file__), "..",
+                                     "scripts", "stream_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
