@@ -68,6 +68,17 @@ in-graph gather, both at K=1, the only arms that INCLUDE steady-state
 data work).  All measured N-interleaved with *_noise_band_pct per the
 r6 protocol.  Opt out with FDT_BENCH_KDIS=0.
 
+Round-19 additions (shard_map kernel layer): the tp-mesh kernel A/B —
+transformer_tp2_{flash,ffn,quant}_{kernel,fallback}_step_ms, the
+bs256/seq256 NGD step on a dp x tp=2 mesh per recovered kernel,
+kernel-via-shard_map (parallel/kernel_shard.py) vs the forced pre-r19
+fallback (FDT_KERNEL_SHARD=0), N>=3 interleaved (FDT_BENCH_TPK=0 opts
+out; the ffn cell is TPU-only — interpret mode would measure the
+interpreter) — and transformer_bs256_seq256_fp8_e5m2_grad_step_ms, the
+FP8-LM completion (fp8 forward + E5M2 JIT-scaled gradient quantization
++ quantized dW/dx), interleaved with the r13 quant set so its A/B twin
+is the plain fp8 arm.
+
 Round-18 additions (streaming data plane): data_path_stream_step_ms
 joins the input-pipeline A/B — the same ResNet NGD program fed from a
 DISK-sharded split through the double-buffered device window
@@ -299,6 +310,7 @@ def timed_transformer(bs: int, seq: int, steps: int,
         batch_size=bs, seq_len=seq, use_ngd=(opt == "ngd"),
         optimizer=opt, precision="bf16", epochs=1,
         quant=os.environ.get("FDT_BENCH_TF_QUANT", "") or "none",
+        quant_grad=os.environ.get("FDT_BENCH_TF_QUANT_GRAD", "") or "none",
         remat=remat,
         remat_policy=os.environ.get("FDT_BENCH_TF_REMAT_POLICY",
                                     "") or "attn_out",
@@ -1504,6 +1516,12 @@ PRODUCED_METRIC_PATTERNS = (
     "transformer_bs256_seq256_quant_off_step_ms",   # r13 quant A/B
     "transformer_bs256_seq256_int8_step_ms",
     "transformer_bs256_seq256_fp8_step_ms",
+    # r19 FP8-LM completion: fp8 forward + E5M2 JIT-scaled gradient
+    # quantization (its A/B twin is the fp8 arm above)
+    "transformer_bs256_seq256_fp8_e5m2_grad_step_ms",
+    # r19 shard_map kernel layer: per recovered kernel on a dp x tp=2
+    # mesh, kernel-via-shard_map vs forced fallback (FDT_KERNEL_SHARD=0)
+    "transformer_tp2_*_step_ms",
     "quant_peak_tflops_assumed",
     "transformer_bs256_seq256_k*_step_ms",     # r8 K ladder
     "resnet_bs512_k*_step_ms",
@@ -1523,6 +1541,8 @@ NOISE_BANDED_STEP_MS = (
     "transformer_bs256_seq256_quant_off_step_ms",
     "transformer_bs256_seq256_int8_step_ms",
     "transformer_bs256_seq256_fp8_step_ms",
+    "transformer_bs256_seq256_fp8_e5m2_grad_step_ms",
+    "transformer_tp2_*_step_ms",
     "transformer_bs256_seq256_k*_step_ms",
     "resnet_bs512_k*_step_ms",
     "data_path_host_step_ms", "data_path_resident_step_ms",
@@ -1884,9 +1904,54 @@ def main() -> None:
         # "off" is the bf16 baseline measured through the SAME child
         # path so the pair shares every other variable.
         _, fmt, cbs, cseq = child.split("_")
-        if fmt != "off":
+        if fmt == "e5m2grad":
+            # r19 FP8-LM completion arm: fp8-E4M3 forward + fp8-E5M2
+            # JIT-scaled gradient quantization with the quantized
+            # dW/dx GEMMs — the A/B twin of the plain fp8 arm
+            os.environ["FDT_BENCH_TF_QUANT"] = "fp8"
+            os.environ["FDT_BENCH_TF_QUANT_GRAD"] = "fp8_e5m2"
+        elif fmt != "off":
             os.environ["FDT_BENCH_TF_QUANT"] = fmt
         print(json.dumps(timed_transformer(int(cbs), int(cseq), tf_steps)))
+        return
+    if child.startswith("tpk_"):
+        # r19 shard_map kernel-layer A/B: one (kernel, mode) cell per
+        # child on a dp x tp=2 mesh — mode "kernel" runs the recovered
+        # per-shard kernel through parallel/kernel_shard.py, mode
+        # "fallback" forces the pre-r19 warned reroute with
+        # FDT_KERNEL_SHARD=0 (the layer's kill switch IS the A/B arm).
+        import warnings as _w
+
+        import jax as _jax
+        _, kern, mode = child.split("_")
+        n_dev = _jax.device_count()
+        if n_dev < 2:
+            print(json.dumps({"skipped": f"tp=2 arm needs >=2 chips, "
+                                         f"host exposes {n_dev}"}))
+            return
+        if kern == "ffn" and _jax.default_backend() != "tpu":
+            # off-TPU the fused-FFN kernel runs in Pallas INTERPRET mode
+            # (orders of magnitude slower) — the cell would measure the
+            # interpreter, not the kernel; read this pair on TPU
+            print(json.dumps({"skipped": "ffn kernel cell is TPU-only "
+                                         "(interpret mode off-TPU)"}))
+            return
+        dp = max(1, min(n_dev // 2, 256))
+        while 256 % dp:
+            dp -= 1
+        os.environ["FDT_BENCH_TF_MESH"] = f"dp={dp},tp=2"
+        if mode == "fallback":
+            os.environ["FDT_KERNEL_SHARD"] = "0"
+        if kern == "flash":
+            os.environ["FDT_BENCH_TF_ATTN"] = "flash"
+        elif kern == "ffn":
+            os.environ["FDT_BENCH_TF_FFN"] = "pallas"
+        elif kern == "quant":
+            os.environ["FDT_BENCH_TF_QUANT"] = "int8"
+        rsteps = int(os.environ.get("FDT_BENCH_ROUTE_STEPS", "10"))
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")   # the fallback arm warns by design
+            print(json.dumps(timed_transformer(256, 256, rsteps)))
         return
     if child == "ab_ln_256_256":
         # tentpole A/B arm: LayerNorm saved-stats VJP OFF (r5 behavior)
@@ -2286,7 +2351,9 @@ def main() -> None:
         if os.environ.get("FDT_BENCH_QUANT", "1") != "0":
             qreps = max(1, int(os.environ.get("FDT_BENCH_QUANT_REPEATS",
                                               "5")))
-            q_runs = {m: [] for m in ("off", "int8", "fp8")}
+            # e5m2grad (r19): the fp8 arm + --quant_grad fp8_e5m2 — its
+            # A/B twin is the plain fp8 arm in the same interleaved set
+            q_runs = {m: [] for m in ("off", "int8", "fp8", "e5m2grad")}
             for _ in range(qreps):
                 for m in q_runs:
                     r = _run_child(f"quant_{m}_256_256")
@@ -2301,20 +2368,55 @@ def main() -> None:
                     continue
                 ms = sorted(r["elapsed"] / tf_steps * 1e3 for r in rs)
                 med = ms[len(ms) // 2]
-                tag = "quant_off" if m == "off" else m
+                tag = {"off": "quant_off",
+                       "e5m2grad": "fp8_e5m2_grad"}.get(m, m)
                 key = f"transformer_bs256_seq256_{tag}_step_ms"
                 record[key] = round(med, 2)
                 if len(ms) > 1 and med:
                     record[key + "_noise_band_pct"] = round(
                         (ms[-1] - ms[0]) / med * 100.0, 1)
-                if m != "off":
+                if m in ("int8", "fp8"):
                     # quantized roofline: achieved TFLOP/s at the SAME
                     # analytic FLOP count, MFU vs the low-precision peak
+                    # (the e5m2grad arm reads against its fp8 twin's
+                    # step_ms instead — same forward, quantized backward)
                     tflops = mf_q / (med / 1e3) / 1e12 / n_chips
                     record[f"transformer_bs256_seq256_{m}"
                            f"_achieved_tflops_per_chip"] = round(tflops, 1)
                     record[f"transformer_bs256_seq256_{m}_mfu_pct"] = \
                         round(100.0 * tflops / qpeak, 1)
+        # tp-mesh kernel A/B arms (r19 tentpole): the bs256/seq256 NGD
+        # train step on a dp x tp=2 mesh, each recovered kernel measured
+        # kernel-via-shard_map vs forced fallback (FDT_KERNEL_SHARD=0 —
+        # the layer's kill switch IS the off arm), N>=3 INTERLEAVED per
+        # the r6 noise protocol.  On this CPU container the pairs
+        # measure the routing/collective machinery (flash runs its
+        # blockwise twin per shard, quant the reference GEMMs); the
+        # kernel-side wins land with the first live TPU bench — the ffn
+        # cell is TPU-only (interpret mode would measure the
+        # interpreter).  Opt out: FDT_BENCH_TPK=0.
+        if os.environ.get("FDT_BENCH_TPK", "1") != "0":
+            treps = max(1, int(os.environ.get("FDT_BENCH_TPK_REPEATS",
+                                              "3")))
+            rsteps = int(os.environ.get("FDT_BENCH_ROUTE_STEPS", "10"))
+            tpk_runs = {(kern, mode): []
+                        for kern in ("flash", "ffn", "quant")
+                        for mode in ("kernel", "fallback")}
+            for _ in range(treps):
+                for (kern, mode) in tpk_runs:
+                    r = _run_child(f"tpk_{kern}_{mode}")
+                    if r and "elapsed" in r:
+                        tpk_runs[(kern, mode)].append(r)
+            for (kern, mode), rs in tpk_runs.items():
+                if not rs:
+                    continue
+                ms = sorted(r["elapsed"] / rsteps * 1e3 for r in rs)
+                med = ms[len(ms) // 2]
+                key = f"transformer_tp2_{kern}_{mode}_step_ms"
+                record[key] = round(med, 2)
+                if len(ms) > 1 and med:
+                    record[key + "_noise_band_pct"] = round(
+                        (ms[-1] - ms[0]) / med * 100.0, 1)
         # K-step fused dispatch ladder + data-path A/B (r8 tentpole):
         # per-step time at K in {1, 4, 16} on the device-resident path
         # for both workloads, and the host-vs-resident input-pipeline

@@ -460,27 +460,32 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
         impl = resolve_attention(cfg, mesh)
         tp = tp_size(mesh)
         sp_axis, sp_ax_size = seq_parallel_axis(mesh)
-        if impl == "flash" and tp > 1:
-            # capability fallback, not a routing decision: the flash
-            # Pallas kernel is a custom call XLA's partitioner cannot
-            # split over the model axis (it would gather full q/k/v per
-            # layer, silently defeating tp) — the shard_map sequence-
-            # parallel strategies keep attention model-parallel with
-            # jnp-only collectives, so flash reroutes to them on tp
-            # meshes (explicit --attention flash included)
-            # validate against the axis the model will execute over
-            # (sp_ax_size — seq_parallel_axis prefers sp), not tp
+        from faster_distributed_training_tpu.parallel import kernel_shard
+        if impl == "flash" and tp > 1 \
+                and not kernel_shard.flash_serviceable(mesh, cfg.n_heads):
+            # REGISTERED warned fallback (scripts/check_kernel_routing):
+            # the r19 shard_map layer runs the flash kernel per-shard on
+            # each device's local heads, so a serviceable tp mesh (heads
+            # divide tp, FDT_KERNEL_SHARD armed) keeps flash.  Only the
+            # non-dividing / killed cases reroute to the shard_map
+            # sequence-parallel strategies (explicit --attention flash
+            # included) — validated against the axis the model will
+            # execute over (sp_ax_size — seq_parallel_axis prefers sp)
             fallback = _route_model_axis(cfg, sp_ax_size) or "dense"
             import warnings
             warnings.warn(
-                f"attention 'flash' cannot partition over the tp axis "
-                f"(Pallas custom call); using '{fallback}' "
+                f"attention 'flash' cannot run head-sharded on this "
+                f"{dict(mesh.shape)} mesh "
+                + (f"(n_heads={cfg.n_heads} does not divide tp={tp})"
+                   if kernel_shard.enabled() else
+                   "(FDT_KERNEL_SHARD=0 disables the shard_map kernel "
+                   "layer)")
+                + f"; using '{fallback}' "
                 + ("sequence-parallel attention over tp"
                    if fallback != "dense" else
                    "attention (seq_len doesn't divide the tp axis, so "
                    "the sequence-parallel strategies can't serve it "
-                   "either)")
-                + f" on this {dict(mesh.shape)} mesh", stacklevel=2)
+                   "either)"), stacklevel=2)
             impl = fallback
         mlp_impl = cfg.mlp_impl or (
             "pallas" if jax.default_backend() == "tpu" else "fused")
@@ -509,18 +514,26 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                 ffn_impl = "flax"
         if ffn_impl == "pallas":
             # sharded meshes run the kernel per-shard via shard_map over
-            # the data axes (fused_ffn_sublayer_sharded) — EXCEPT tp,
-            # whose FFN weights are tensor-parallel: gathering them per
-            # step inside the shard_map boundary would defeat tp, so
-            # that combination falls back to the flax composition.
-            if (mesh is not None and "tp" in mesh.axis_names
-                    and mesh.shape["tp"] > 1):
+            # the data axes (fused_ffn_sublayer_sharded); tp meshes run
+            # the Megatron column-then-row decomposition through the r19
+            # shard_map layer (kernel_shard.fused_ffn_sublayer_tp — the
+            # tp weight shards are consumed in place, no per-step
+            # gather).  The flax composition survives only as the
+            # REGISTERED warned fallback: FDT_KERNEL_SHARD=0 or shapes
+            # tp doesn't divide.
+            if tp > 1 and not kernel_shard.ffn_tp_serviceable(
+                    mesh, cfg.d_ff, cfg.seq_len):
                 import warnings
                 warnings.warn(
-                    "--ffn_impl pallas does not support tensor-parallel "
-                    "FFN weights (the per-shard kernel would gather them "
-                    "each step); falling back to the flax FFN composition "
-                    f"on this {dict(mesh.shape)} mesh", stacklevel=2)
+                    "--ffn_impl pallas cannot run the Megatron column/"
+                    f"row-sharded kernel on this {dict(mesh.shape)} mesh "
+                    + (f"(d_ff={cfg.d_ff} or seq_len={cfg.seq_len} does "
+                       f"not divide the tp/sp axes)"
+                       if kernel_shard.enabled() else
+                       "(FDT_KERNEL_SHARD=0 disables the shard_map "
+                       "kernel layer)")
+                    + "; falling back to the flax FFN composition",
+                    stacklevel=2)
                 ffn_impl = "flax"
             elif jax.default_backend() != "tpu":
                 import warnings
@@ -554,32 +567,37 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                     stacklevel=2)
             use_pallas = None
             if tp > 1:
-                # capability fallback, not a routing decision — the
-                # same reason flash reroutes on tp meshes above: the
-                # quant Pallas kernel is a custom call XLA's
-                # partitioner cannot split over the model axis.  The
-                # XLA reference path is a plain dot_general on int8/
-                # fp8 operands, which partitions like any other dot,
-                # so quantization itself stays on.
-                warnings.warn(
-                    f"--quant {cfg.quant}: the Pallas quant matmul "
-                    f"kernel cannot partition over the tp axis; using "
-                    f"the XLA reference quantized GEMMs on this "
-                    f"{dict(mesh.shape)} mesh (quantization stays on)",
-                    stacklevel=2)
-                use_pallas = False
+                # r19: serviceable sites (their sharded kernel dim
+                # divides tp) run the quant kernel PER SHARD on the
+                # Megatron column/row tiles through the shard_map layer
+                # (QuantDense mesh/tp_dim routing); anything else takes
+                # the REGISTERED warned fallback — the XLA reference
+                # path is a plain dot_general on int8/fp8 operands,
+                # which partitions like any other dot, so quantization
+                # itself stays on either way.
+                div = (cfg.n_heads % tp == 0 and cfg.d_ff % tp == 0
+                       and cfg.d_model % tp == 0)
+                if not (kernel_shard.enabled() and div):
+                    warnings.warn(
+                        f"--quant {cfg.quant}: the quant matmul kernel "
+                        f"cannot run column/row-sharded on this "
+                        f"{dict(mesh.shape)} mesh "
+                        + (f"(n_heads={cfg.n_heads}/d_ff={cfg.d_ff}/"
+                           f"d_model={cfg.d_model} must all divide "
+                           f"tp={tp})" if kernel_shard.enabled() else
+                           "(FDT_KERNEL_SHARD=0 disables the shard_map "
+                           "kernel layer)")
+                        + "; using the XLA reference quantized GEMMs "
+                        "(quantization stays on)", stacklevel=2)
+                    use_pallas = False
             elif jax.default_backend() != "tpu":
                 # the designed off-TPU path (tests/CPU convergence
                 # harness): reference GEMMs, same math, no interpret-
                 # mode Pallas on the hot path
                 use_pallas = False
-            if ffn_impl == "pallas":
-                warnings.warn(
-                    "--ffn_impl pallas does not compose with --quant "
-                    "(the monolithic fused-FFN kernel's GEMMs are "
-                    "bf16-only); using the flax FFN composition with "
-                    "quantized Dense GEMMs instead", stacklevel=2)
-                ffn_impl = "flax"
+            # --ffn_impl pallas composes with --quant since r19: the
+            # generalized fused-FFN kernel runs its two GEMMs on the
+            # quantized operands in-kernel (models/transformer.py)
             quant = policy._replace(use_pallas=use_pallas,
                                     frozen_scales=bool(serving))
         # the model sees the mesh whenever it has work to do with it:
@@ -602,7 +620,10 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                          remat_policy=cfg.remat_policy,
                          dropout_impl=cfg.dropout_impl, ffn_impl=ffn_impl,
                          fused_qkv=not tricks_off, quant=quant,
-                         lm_head=getattr(cfg, "task", "cls") == "lm")
+                         lm_head=getattr(cfg, "task", "cls") == "lm",
+                         tie_lm_head=(getattr(cfg, "task", "cls") == "lm"
+                                      and getattr(cfg, "tie_lm_head",
+                                                  True)))
     if (getattr(cfg, "quant", "none") or "none") != "none":
         import warnings
         warnings.warn(

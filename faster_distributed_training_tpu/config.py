@@ -33,6 +33,15 @@ class TrainConfig:
                                       # perplexity metric; no mixup/
                                       # pooler.  The streamed text
                                       # workload's objective (r18)
+    tie_lm_head: bool = True          # tie the LM head to token_embedding
+                                      # (logits = h @ E^T): ~vocab*d_model
+                                      # fewer params, the vocab-sharding
+                                      # TP rule serves the head for free.
+                                      # --untie_lm_head restores the r18
+                                      # separate projection; untied
+                                      # checkpoints restore into tied
+                                      # models via a warned compat shim
+                                      # (train/checkpoint.py)
 
     # -- optimization (reference flag surface) ----------------------------
     lr: float = 0.1
@@ -79,11 +88,22 @@ class TrainConfig:
                                       # bitwise (ops/quant.py,
                                       # train.amp.QuantPolicy).  Kill
                                       # switch: FDT_QUANT=0 (plain matmuls,
-                                      # same state tree).  tp meshes and
-                                      # off-TPU backends route the GEMMs
-                                      # through the XLA reference path
-                                      # (Pallas custom calls don't
-                                      # partition over tp)
+                                      # same state tree).  tp meshes run
+                                      # the quant kernel PER-SHARD on the
+                                      # Megatron column/row tiles through
+                                      # the r19 shard_map layer (parallel/
+                                      # kernel_shard.py); off-TPU backends
+                                      # and the FDT_KERNEL_SHARD=0 /
+                                      # non-dividing-shape fallbacks use
+                                      # the XLA reference path (warned)
+    quant_grad: str = "none"          # none | fp8_e5m2: quantize the
+                                      # backward cotangents to the wide-
+                                      # range E5M2 grid (JIT per-tensor
+                                      # scale) and run BOTH gradient GEMMs
+                                      # on quantized operands — the FP8-LM
+                                      # recipe's gradient half (requires
+                                      # --quant int8/fp8; ops/quant.py
+                                      # _quant_dot_bwd)
 
     # -- device / mesh ----------------------------------------------------
     device: str = "auto"              # tpu | cpu | auto
@@ -417,6 +437,7 @@ def resolve_tricks(cfg: "TrainConfig") -> "TrainConfig":
     return cfg.replace(
         precision="fp32",
         quant="none",
+        quant_grad="none",
         attention="dense",
         mlp_impl="naive",
         dropout_impl="xla",
@@ -474,8 +495,19 @@ def build_parser(prog: str = "fdt",
                         "(s32 accumulation) or fp8 E4M3 (fp32 accumulation) "
                         "with per-tensor delayed scaling; scale state rides "
                         "the train-state carry so K-dispatch/resume stay "
-                        "bitwise.  FDT_QUANT=0 kills it; tp meshes/off-TPU "
-                        "fall back to the XLA reference GEMMs (warned)")
+                        "bitwise.  FDT_QUANT=0 kills it; tp meshes run "
+                        "the kernel per-shard via the shard_map layer "
+                        "(parallel/kernel_shard.py); off-TPU and the "
+                        "FDT_KERNEL_SHARD=0 / non-dividing fallbacks use "
+                        "the XLA reference GEMMs (warned)")
+    p.add_argument("--quant_grad", default=d.quant_grad,
+                   choices=["none", "fp8_e5m2"],
+                   help="gradient quantization (requires --quant int8/"
+                        "fp8): quantize the backward cotangents to the "
+                        "wide-range fp8-E5M2 grid at a just-in-time "
+                        "per-tensor scale and run BOTH gradient GEMMs on "
+                        "quantized operands — the FP8-LM recipe's "
+                        "gradient half (ops/quant.py)")
     p.add_argument("--mesh", default="", type=str,
                    help="mesh as axis=size pairs, e.g. 'dp=4,tp=2' (a 2D "
                         "(data, model) mesh) or 'dp=4,fsdp=2'; axis "
@@ -633,6 +665,12 @@ def build_parser(prog: str = "fdt",
                         "the transformer (per-position vocab logits, "
                         "shifted-target loss, perplexity metric; no "
                         "mixup) — the streamed LM workload")
+    p.add_argument("--untie_lm_head", action="store_true",
+                   help="--task lm: use the r18 separate lm_head "
+                        "projection instead of tying the head to "
+                        "token_embedding (logits = h @ E^T, the r19 "
+                        "default; untied checkpoints restore into tied "
+                        "models via a warned compat shim)")
     p.add_argument("--stream_dir", default=d.stream_dir, type=str,
                    help="sharded stream dataset root (train/ + test/ "
                         "subdirs; scripts/shard_dataset.py writes one) — "
@@ -761,6 +799,8 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         optimizer=args.optimizer, schedule=args.schedule,
         ngd_max_dim=args.ngd_max_dim,
         device=args.device, precision=args.precision, quant=args.quant,
+        quant_grad=args.quant_grad,
+        tie_lm_head=not args.untie_lm_head,
         fsdp=args.fsdp, zero1=args.zero1, host_offload=args.host_offload,
         remat=args.remat, remat_policy=args.remat_policy,
         data_dir=args.data_dir, subset_stride=args.subset_stride, seed=args.seed,

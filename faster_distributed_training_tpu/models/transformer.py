@@ -106,14 +106,18 @@ def sinusoidal_table(max_len: int, d_model: int) -> np.ndarray:
 class Embeddings(nn.Module):
     """token + learned-position + segment embeddings, scaled by sqrt(d_model)
     (transformer.py:132-156). Tables and the sum stay fp32 (the reference's
-    autocast-disabled island), cast to compute dtype by the caller."""
+    autocast-disabled island), cast to compute dtype by the caller.
+    Returns (embeddings, token_table) — the raw token table feeds the
+    tied LM head (Transformer.tie_lm_head: logits = h @ E^T) without
+    moving the param out of its checkpointed location."""
     d_model: int
     vocab: int
     maxlen: int
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array, token_types: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, token_types: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
         tok = self.param("token_embedding", xavier_uniform,
                          (self.vocab, self.d_model), self.param_dtype)
         pos = self.param("pos_embedding", xavier_uniform,
@@ -124,7 +128,7 @@ class Embeddings(nn.Module):
         tokens = jnp.take(tok, x, axis=0).astype(jnp.float32)
         positions = pos[None, :L, :].astype(jnp.float32)
         segments = jnp.take(seg, token_types[:, :L], axis=0).astype(jnp.float32)
-        return (tokens + positions + segments) * math.sqrt(self.d_model)
+        return (tokens + positions + segments) * math.sqrt(self.d_model), tok
 
 
 def dense_attention(q, k, v, mask, dropout_rate, deterministic, dropout_rng):
@@ -208,23 +212,40 @@ class MultiheadAttention(nn.Module):
                          use_pallas=self.quant.use_pallas,
                          frozen_scales=getattr(self.quant,
                                                "frozen_scales", False),
+                         grad_fmt=getattr(self.quant, "grad_fmt", None),
+                         mesh=self.mesh,
                          dtype=self.dtype, param_dtype=self.param_dtype)
                     if self.quant is not None else None)
         # projection-boundary annotations for a (data, model) mesh
         # (SNIPPETS [3]): heads over tp through the dense attention
         # math, the out-proj input sharded on its contiguous-head
         # d_model grouping so the tp-sharded `out` kernel contracts
-        # locally and XLA inserts exactly one psum.  The kernel impls
-        # (flash/ring/ulysses) own their layouts — flash never meets a
-        # tp mesh (build_model reroutes it) and the sp strategies
-        # re-shard inside shard_map — so only dense is annotated.
+        # locally and XLA inserts exactly one psum.  flash on a
+        # serviceable tp mesh (r19, heads divide tp) keeps the same
+        # head-over-tp layout — the annotations line up with the
+        # shard_map boundary of kernel_shard.flash_attention_sharded so
+        # no resharding happens at entry/exit; ring/ulysses re-shard
+        # inside their own shard_map and stay un-annotated.
+        from faster_distributed_training_tpu.parallel import kernel_shard
         dat = mesh_data_axes(self.mesh)
+        # the SAME predicate the flash dispatch below uses (incl. the
+        # FDT_KERNEL_SHARD kill switch): annotating head-over-tp while
+        # dispatching the unsharded kernel would make XLA all-gather
+        # q/k/v around the custom call — the exact failure r19 closes
         head_tp = (tp_size(self.mesh) > 1
-                   and self.attention_impl == "dense")
+                   and (self.attention_impl == "dense"
+                        or (self.attention_impl == "flash"
+                            and kernel_shard.flash_serviceable(
+                                self.mesh, self.h))))
         if self.fused_qkv:
             if quant_kw is not None:
+                # tp_dim names the Megatron role of each site's kernel
+                # for the r19 shard_map quant layer (parallel/
+                # kernel_shard.py): qkv shards the head axis (column-
+                # parallel), q/k/v their output features, `out` its
+                # input rows (row-parallel, one psum)
                 qkv = QuantDense((3, self.h, d_k), kernel_init=qkv_xavier,
-                                 name="qkv", **quant_kw)(x)
+                                 name="qkv", tp_dim=2, **quant_kw)(x)
             else:
                 qkv = nn.DenseGeneral((3, self.h, d_k), axis=-1,
                                       kernel_init=qkv_xavier,
@@ -238,7 +259,7 @@ class MultiheadAttention(nn.Module):
             def proj(name):
                 if quant_kw is not None:
                     y = QuantDense(self.d_model, kernel_init=xavier_uniform,
-                                   name=name, **quant_kw)(x)
+                                   name=name, tp_dim=1, **quant_kw)(x)
                 else:
                     y = nn.Dense(self.d_model, kernel_init=xavier_uniform,
                                  dtype=self.dtype,
@@ -266,14 +287,27 @@ class MultiheadAttention(nn.Module):
         if self.attention_impl == "flash":
             from faster_distributed_training_tpu.ops.flash_attention import (
                 flash_attention)
+            from faster_distributed_training_tpu.parallel import kernel_shard
             # flash_save_stats=True defers to the FDT_FLASH_SAVE_STATS
             # env default (None) so the A/B kill switch still works;
             # False (rematted attention) is a hard override
-            ctx = flash_attention(q, k, v, mask=mask,
-                                  dropout_rate=drop_rate,
-                                  dropout_seed=drop_seed,
-                                  save_stats=(None if self.flash_save_stats
-                                              else False))
+            save = None if self.flash_save_stats else False
+            if kernel_shard.flash_serviceable(self.mesh, self.h):
+                # r19: heads divide tp — the flash kernel runs PER SHARD
+                # on each device's local heads under shard_map (parallel/
+                # kernel_shard.py) instead of falling back to the slower
+                # sequence-parallel strategies; dropout masks address
+                # GLOBAL (b, h) stream indices, so they are placement-
+                # invariant vs the unsharded kernel
+                ctx = kernel_shard.flash_attention_sharded(
+                    q, k, v, mask, self.mesh,
+                    dropout_rate=drop_rate, dropout_seed=drop_seed,
+                    save_stats=save)
+            else:
+                ctx = flash_attention(q, k, v, mask=mask,
+                                      dropout_rate=drop_rate,
+                                      dropout_seed=drop_seed,
+                                      save_stats=save)
         elif self.attention_impl in ("ring", "ulysses"):
             if self.mesh is None:
                 raise ValueError(
@@ -321,8 +355,12 @@ class MultiheadAttention(nn.Module):
         # attention twice, VERDICT r3 #3).
         ctx = checkpoint_name(ctx, "attn_out")
         if quant_kw is not None:
+            # tp_dim=0: the out-proj is the attention block's Megatron
+            # ROW-parallel site — its kernel's input dim is tp-sharded
+            # (the contiguous-head d_model grouping annotated above), so
+            # the per-shard GEMM contracts locally and psums once
             return QuantDense(self.d_model, kernel_init=xavier_uniform,
-                              name="out", **quant_kw)(ctx)
+                              name="out", tp_dim=0, **quant_kw)(ctx)
         return nn.Dense(self.d_model, kernel_init=xavier_uniform,
                         dtype=self.dtype, param_dtype=self.param_dtype,
                         name="out")(ctx)
@@ -359,9 +397,15 @@ class PositionalWiseFFN(nn.Module):
                        margin=self.quant.margin,
                        use_pallas=self.quant.use_pallas,
                        frozen_scales=getattr(self.quant,
-                                             "frozen_scales", False), **kw)
-            dense_0 = QuantDense(self.d_ff, name="Dense_0", **qkw)
-            dense_1 = QuantDense(self.d_model, name="Dense_1", **qkw)
+                                             "frozen_scales", False),
+                       grad_fmt=getattr(self.quant, "grad_fmt", None),
+                       mesh=self.mesh, **kw)
+            # Megatron roles for the r19 shard_map quant layer: Dense_0
+            # column-parallel (d_ff out), Dense_1 row-parallel (d_ff in,
+            # one psum) — the _TP_RULES layout
+            dense_0 = QuantDense(self.d_ff, name="Dense_0", tp_dim=1, **qkw)
+            dense_1 = QuantDense(self.d_model, name="Dense_1", tp_dim=0,
+                                 **qkw)
         else:
             dense_0 = nn.Dense(self.d_ff, **kw)
             dense_1 = nn.Dense(self.d_model, **kw)
@@ -392,20 +436,66 @@ class PositionalWiseFFN(nn.Module):
 REMAT_POLICIES = ("layer", "ffn", "attn_out", "dots")
 
 
+class _QuantDenseMirror(nn.Module):
+    """QuantDense's exact param + batch_stats trees (kernel/bias under
+    the module name, amax_history_x/amax_history_w in batch_stats)
+    WITHOUT its compute — the quantized fused-FFN path reads the leaves
+    and runs the math in the generalized kernel, so checkpoints (params
+    AND scale state) interchange with the Flax QuantDense composition."""
+    features: int
+    amax_history_len: int = 16
+    kernel_init: object = xavier_uniform
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, probe: jax.Array):
+        from faster_distributed_training_tpu.ops.quant import (
+            fresh_amax_history)
+
+        kernel = self.param("kernel", self.kernel_init,
+                            (probe.shape[-1], self.features),
+                            self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), self.param_dtype)
+        hx = self.variable("batch_stats", "amax_history_x",
+                           fresh_amax_history, self.amax_history_len)
+        hw = self.variable("batch_stats", "amax_history_w",
+                           fresh_amax_history, self.amax_history_len)
+        return kernel, bias, hx, hw
+
+
 class _FFNParamMirror(nn.Module):
     """Declares PositionalWiseFFN's exact param tree (Dense_0 -> d_ff,
     Dense_1 -> d_model, same auto-naming order) WITHOUT its compute —
     the fused-FFN kernel path (`ffn_impl="pallas"`) reads the leaves and
     runs the math in `ops.fused_ffn`, keeping checkpoints interchangeable
     between the Flax and kernel implementations.  The probe call is
-    (1, d_model) — parameter creation only, negligible compute."""
+    (1, d_model) — parameter creation only, negligible compute.
+
+    With ``quant`` set (a QuantPolicy) the mirror declares QuantDense's
+    tree instead — same params plus the four amax histories in
+    batch_stats — and returns them after the weights, so the quantized
+    fused kernel (r19) rolls the exact state the Flax quantized
+    composition would."""
     d_model: int
     d_ff: int
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
+    quant: Optional[Any] = None
 
     @nn.compact
     def __call__(self, probe: jax.Array):
+        if self.quant is not None:
+            qm = dict(amax_history_len=self.quant.amax_history_len,
+                      kernel_init=xavier_uniform,
+                      param_dtype=self.param_dtype)
+            w1, b1, hx1, hw1 = _QuantDenseMirror(
+                self.d_ff, name="Dense_0", **qm)(probe)
+            w2, b2, hx2, hw2 = _QuantDenseMirror(
+                self.d_model, name="Dense_1", **qm)(
+                    jnp.zeros(probe.shape[:-1] + (self.d_ff,),
+                              probe.dtype))
+            return w1, b1, w2, b2, (hx1, hw1, hx2, hw2)
         kw = dict(kernel_init=xavier_uniform, dtype=self.dtype,
                   param_dtype=self.param_dtype)
         d0 = nn.Dense(self.d_ff, **kw)
@@ -414,7 +504,7 @@ class _FFNParamMirror(nn.Module):
         return (d0.variables["params"]["kernel"],
                 d0.variables["params"]["bias"],
                 d1.variables["params"]["kernel"],
-                d1.variables["params"]["bias"])
+                d1.variables["params"]["bias"], None)
 
 
 class EncoderLayer(nn.Module):
@@ -441,10 +531,11 @@ class EncoderLayer(nn.Module):
     ffn_impl: str = "flax"    # flax | pallas (ops/fused_ffn.py mega-kernel)
     flash_save_stats: bool = True   # False under attention-wrapping remat
     quant: Optional[Any] = None     # QuantPolicy threaded to attention +
-                                    # FFN projections; forces the flax
-                                    # FFN composition (the monolithic
-                                    # fused kernel's GEMMs are bf16-only
-                                    # — build_model warns and reroutes)
+                                    # FFN projections; with ffn_impl
+                                    # "pallas" the generalized fused
+                                    # kernel runs its two GEMMs on the
+                                    # quantized operands in-kernel (r19
+                                    # — the bf16-only caveat is gone)
 
     @nn.compact
     def __call__(self, h: jax.Array, mask: Optional[jax.Array],
@@ -489,7 +580,7 @@ class EncoderLayer(nn.Module):
         ffn_dropout_active = (train and self.dropout_impl != "none"
                               and (self.dropout_ffn > 0
                                    or self.dropout_connection_ffn > 0))
-        if (self.ffn_impl == "pallas" and self.quant is None
+        if (self.ffn_impl == "pallas"
                 and (not ffn_dropout_active
                      or self.dropout_impl == "hash")):
             # fused sublayer (ops/fused_ffn.py): LN + FFN + both dropout
@@ -499,18 +590,25 @@ class EncoderLayer(nn.Module):
             # exactly.  On sharded meshes the kernel runs PER SHARD via
             # fused_ffn_sublayer_sharded (shard_map over the data axes;
             # each shard addresses the GLOBAL dropout index space, so
-            # masks are placement-invariant); only tp SIZE > 1 falls
-            # back to Flax in build_model (gathering tensor-parallel FFN
-            # weights per step would defeat tp).
+            # masks are placement-invariant); tp meshes run the Megatron
+            # column-then-row decomposition through the r19 shard_map
+            # kernel layer (parallel/kernel_shard.py — w1/w2 consumed as
+            # their tp shards in place, ONE psum per sublayer) when
+            # d_ff/seq divide, with the Flax composition as the
+            # registered warned fallback (build_model).  --quant rides
+            # the same kernels (the generalized core quantizes the GEMMs
+            # in-kernel at the delayed scales and emits the step amaxes).
             from faster_distributed_training_tpu.ops.fused_ffn import (
-                fused_ffn_sublayer, fused_ffn_sublayer_sharded)
+                ffn_core_generalized, fused_ffn_sublayer,
+                fused_ffn_sublayer_sharded)
+            from faster_distributed_training_tpu.parallel import kernel_shard
             lnf = ln("ln_ffn")
             lnf(h[..., :1, :])      # param creation only (probe row)
             ln_scale = lnf.variables["params"]["scale"]
             ln_bias = lnf.variables["params"]["bias"]
-            w1, b1, w2, b2 = _FFNParamMirror(
+            w1, b1, w2, b2, qstate = _FFNParamMirror(
                 self.d_model, self.d_ff, self.dtype, self.param_dtype,
-                name="ffn")(h[..., :1, :])
+                quant=self.quant, name="ffn")(h[..., :1, :])
             if ffn_dropout_active:
                 seeds = jax.random.bits(self.make_rng("dropout"), (2,),
                                         dtype=jnp.uint32)
@@ -519,17 +617,65 @@ class EncoderLayer(nn.Module):
             else:
                 hid_seed = out_seed = jnp.uint32(0)
                 r_h = r_c = 0.0
-            kernel_args = (h, ln_scale, ln_bias, w1.astype(self.dtype),
-                           b1.astype(self.dtype), w2.astype(self.dtype),
-                           b2.astype(self.dtype), hid_seed, out_seed)
-            if self.mesh is not None and any(
+            fmt = None
+            if self.quant is not None:
+                from faster_distributed_training_tpu.ops.quant import (
+                    quant_enabled, scale_from_history, tensor_amax,
+                    update_amax_history)
+                hx1, hw1, hx2, hw2 = qstate
+                # FDT_QUANT=0 keeps the state tree allocated but runs
+                # the plain bf16/fp32 kernel (the QuantDense contract)
+                fmt = self.quant.fmt if quant_enabled() else None
+            w1c, b1c = w1.astype(self.dtype), b1.astype(self.dtype)
+            w2c, b2c = w2.astype(self.dtype), b2.astype(self.dtype)
+            kernel_args = (h, ln_scale, ln_bias, w1c, b1c, w2c, b2c,
+                           hid_seed, out_seed)
+            gfmt = (getattr(self.quant, "grad_fmt", None)
+                    if fmt is not None else None)
+            if fmt is not None:
+                mg = self.quant.margin
+                scales = (scale_from_history(hx1.value, fmt, mg),
+                          scale_from_history(hw1.value, fmt, mg),
+                          scale_from_history(hx2.value, fmt, mg),
+                          scale_from_history(hw2.value, fmt, mg))
+            else:
+                scales = None
+            if tp_size(self.mesh) > 1:
+                res = kernel_shard.fused_ffn_sublayer_tp(
+                    *kernel_args, mesh=self.mesh,
+                    rate_hidden=r_h, rate_conn=r_c,
+                    quant_fmt=fmt, quant_scales=scales, grad_fmt=gfmt)
+            elif self.mesh is not None and any(
                     self.mesh.shape[ax] > 1 for ax in self.mesh.axis_names):
                 # SPMD: per-shard kernels over the data axes, masks
                 # addressed in the GLOBAL index space (ops/fused_ffn.py)
-                return fused_ffn_sublayer_sharded(
+                res = fused_ffn_sublayer_sharded(
                     *kernel_args, mesh=self.mesh,
-                    rate_hidden=r_h, rate_conn=r_c)
-            return fused_ffn_sublayer(*kernel_args, r_h, r_c)
+                    rate_hidden=r_h, rate_conn=r_c,
+                    quant_fmt=fmt, quant_scales=scales, grad_fmt=gfmt)
+            elif fmt is not None:
+                res = ffn_core_generalized(
+                    h, ln_scale, ln_bias, w1c, b1c, w2c, b2c,
+                    hid_seed, out_seed, 0, 0, 0, r_h, r_c, 1e-6, 1, 1,
+                    dff_glob=self.d_ff, quant_fmt=fmt,
+                    quant_scales=scales, grad_fmt=gfmt)
+            else:
+                return fused_ffn_sublayer(*kernel_args, r_h, r_c)
+            if fmt is None:
+                return res
+            out, amax2 = res
+            # roll the delayed-scaling histories exactly as QuantDense
+            # would: x-side amaxes from the kernel (LN output / post-
+            # dropout activation), w-side from the cast weights
+            if (not getattr(self.quant, "frozen_scales", False)
+                    and self.is_mutable_collection("batch_stats")):
+                hx1.value = update_amax_history(hx1.value, amax2[0])
+                hx2.value = update_amax_history(hx2.value, amax2[1])
+                hw1.value = update_amax_history(hw1.value,
+                                                tensor_amax(w1c))
+                hw2.value = update_amax_history(hw2.value,
+                                                tensor_amax(w2c))
+            return out
         f = ln("ln_ffn")(h)
         ffn_cls = (nn.remat(PositionalWiseFFN, static_argnums=(2,))
                    if self.remat_ffn else PositionalWiseFFN)
@@ -582,14 +728,18 @@ class Transformer(nn.Module):
                                    # logits for next-token prediction
                                    # instead of the CLS pooler/classifier
                                    # — the streamed LM workload's head.
-                                   # Untied projection (the tp vocab-
-                                   # sharding rules match by param name;
-                                   # "lm_head" stays replicated — tying
-                                   # it to token_embedding is a
-                                   # follow-on).  No mixup: sentence-
-                                   # embedding mixup is a classification
-                                   # regularizer with no analog on a
-                                   # dense token objective
+                                   # No mixup: sentence-embedding mixup
+                                   # is a classification regularizer with
+                                   # no analog on a dense token objective
+    tie_lm_head: bool = False      # r19 (ROADMAP r18 follow-on (c)):
+                                   # logits = h @ token_embedding^T — no
+                                   # separate lm_head projection
+                                   # (~vocab*d_model fewer params), and
+                                   # the token_embedding vocab-sharding
+                                   # TP rule serves the head for free.
+                                   # False = the r18 untied nn.Dense
+                                   # head (checkpoint-compatible via the
+                                   # train/checkpoint.py compat shim)
 
     @nn.compact
     def __call__(self, x: jax.Array, token_types: Optional[jax.Array] = None,
@@ -597,8 +747,9 @@ class Transformer(nn.Module):
         B, L = x.shape
         if token_types is None:
             token_types = jnp.zeros_like(x)
-        embeddings = Embeddings(self.d_model, self.vocab, self.maxlen,
-                                self.param_dtype)(x, token_types)
+        embeddings, tok_table = Embeddings(self.d_model, self.vocab,
+                                           self.maxlen,
+                                           self.param_dtype)(x, token_types)
         # x = embeddings + dropout(embeddings + pe): the reference feeds the
         # PositionalEncoding module the embeddings and then ADDS its output to
         # the embeddings again (transformer.py:61-64) — preserved verbatim.
@@ -677,10 +828,19 @@ class Transformer(nn.Module):
             # position (the loss shifts targets, train/steps.py).  Same
             # return shape train and eval — the mixup triplet below is
             # classification-only.
-            logits = nn.Dense(self.vocab, kernel_init=xavier_uniform,
-                              dtype=self.dtype,
-                              param_dtype=self.param_dtype,
-                              name="lm_head")(h)
+            if self.tie_lm_head:
+                # tied head: logits = h @ E^T on the RAW (unscaled)
+                # token table, no bias — the table stays fp32 (the
+                # embedding island) and contracts against the compute-
+                # dtype h with fp32 accumulation
+                logits = jnp.dot(h.astype(self.dtype),
+                                 tok_table.astype(self.dtype).T,
+                                 preferred_element_type=jnp.float32)
+            else:
+                logits = nn.Dense(self.vocab, kernel_init=xavier_uniform,
+                                  dtype=self.dtype,
+                                  param_dtype=self.param_dtype,
+                                  name="lm_head")(h)
             return logits.astype(jnp.float32)
 
         # Pooler: tanh(dense(CLS)) (transformer.py:94-101)

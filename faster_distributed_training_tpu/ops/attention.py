@@ -208,10 +208,14 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def dense_attention_reference(q, k, v, mask=None, dropout_rate: float = 0.0,
-                              dropout_seed: Optional[jax.Array] = None):
+                              dropout_seed: Optional[jax.Array] = None,
+                              dropout_bh: Optional[jax.Array] = None):
     """O(L²) reference (transformer.py:180-193 semantics).  With
     dropout_rate > 0 applies the same index-hash dropout as the
-    blockwise/Pallas paths (softmax first, then drop+rescale)."""
+    blockwise/Pallas paths (softmax first, then drop+rescale).
+    ``dropout_bh``: optional GLOBAL [B,H,1,1] stream index for sharded
+    callers (parallel/kernel_shard.py head-sharded flash); default is
+    the local flattened b*H+h — the blockwise_attention convention."""
     B, H, Lq, _ = q.shape
     Lk = k.shape[2]
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -224,7 +228,9 @@ def dense_attention_reference(q, k, v, mask=None, dropout_rate: float = 0.0,
     if dropout_rate > 0.0:
         seed = (jnp.uint32(0) if dropout_seed is None
                 else dropout_seed.astype(jnp.uint32))
-        p = p * dropout_keep(seed, bh_index(B, H),
+        p = p * dropout_keep(seed,
+                             bh_index(B, H) if dropout_bh is None
+                             else dropout_bh,
                              jnp.arange(Lq, dtype=jnp.int32)[None, None, :,
                                                              None],
                              jnp.arange(Lk, dtype=jnp.int32)[None, None,

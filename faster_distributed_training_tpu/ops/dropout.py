@@ -102,33 +102,40 @@ def hash_words(seed: jax.Array, n: int) -> jax.Array:
 
 
 def keep_factor_rows(seed: jax.Array, global_rows: jax.Array, cols: int,
-                     rate: float) -> jax.Array:
+                     rate: float, col0=0,
+                     cols_glob: int = 0) -> jax.Array:
     """fp32 {0, GRID/t} keep factors for a tile whose per-row GLOBAL row
     ids are ``global_rows`` ((rows,) or (rows,1) u32) — THE single
     source of truth for the hash-dropout mask stream: element (r, c)
     keeps iff the top 16 hash bits of ``fmix(seed ^ (global_rows[r] *
-    cols + c))`` clear the rate threshold.  Explicit row ids let
-    sharded callers (ops/fused_ffn.py under shard_map) address the
+    cols_glob + col0 + c))`` clear the rate threshold.  Explicit row ids
+    let sharded callers (ops/fused_ffn.py under shard_map) address the
     GLOBAL index space even when their local rows are not globally
     contiguous (sequence-sharded layouts) — masks depend only on
-    (seed, global position), never on device placement.
+    (seed, global position), never on device placement.  ``col0`` /
+    ``cols_glob`` extend the same contract to COLUMN-sharded tiles (the
+    Megatron column-parallel fused-FFN hidden, r19): the local tile
+    covers global columns [col0, col0+cols) of a cols_glob-wide tensor.
+    The defaults (0, 0 -> cols) reduce to the original full-width
+    stream bit-for-bit.
 
-    CEILING (ADVICE r5 low): the element index ``global_row*cols + c``
-    mixes in uint32, so the placement-invariance contract holds only for
-    global activation tensors up to 2^32 elements (~4.3 G elements; at
-    d_ff=1024 that is a global batch*seq of ~4.2 M rows).  Past it the
-    index wraps and distant positions silently share mask bits —
-    statistically harmless (the wrapped stream is still uniform) but no
-    longer a unique per-element draw.  If larger global tensors come
-    into scope, widen the mixing to 64 bits (two fmix rounds over row
-    and column) rather than relying on the wrap."""
+    CEILING (ADVICE r5 low): the element index mixes in uint32, so the
+    placement-invariance contract holds only for global activation
+    tensors up to 2^32 elements (~4.3 G elements; at d_ff=1024 that is
+    a global batch*seq of ~4.2 M rows).  Past it the index wraps and
+    distant positions silently share mask bits — statistically harmless
+    (the wrapped stream is still uniform) but no longer a unique
+    per-element draw.  If larger global tensors come into scope, widen
+    the mixing to 64 bits (two fmix rounds over row and column) rather
+    than relying on the wrap."""
     t = _thresh_u16(rate)
     rows = int(np.shape(global_rows)[0])
     if t <= 0:   # rate within half a grid step of 1: drop everything
         return jnp.zeros((rows, cols), jnp.float32)
+    width = int(cols_glob) if cols_glob else cols
     c = lax.broadcasted_iota(jnp.uint32, (rows, cols), 1)
     idx = global_rows.astype(jnp.uint32).reshape(rows, 1) \
-        * jnp.uint32(cols) + c
+        * jnp.uint32(width) + jnp.asarray(col0, jnp.uint32) + c
     h16 = _fmix32(seed.astype(jnp.uint32) ^ idx) >> jnp.uint32(16)
     inv = np.float32(_GRID / t)  # exact-unbiasedness scale (realized keep)
     return jnp.where(h16 < jnp.uint32(t), inv, np.float32(0.0))

@@ -85,6 +85,44 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _pack_seed(dropout_seed, bh0=None) -> jax.Array:
+    """(3,) uint32 dropout operand [seed, b0, h0]: the hash seed plus
+    the caller's GLOBAL (batch, head) shard offsets.  Head-sharded
+    callers (parallel/kernel_shard.py) pass their shard origin as
+    ``bh0``; unsharded callers leave it (0, 0), which — together with
+    h_glob == local H — makes the in-kernel global index reduce to the
+    plain flattened b*H+h bit-for-bit (nothing changes for 1D runs)."""
+    seed = (jnp.uint32(0) if dropout_seed is None
+            else jnp.asarray(dropout_seed, jnp.uint32))
+    if bh0 is None:
+        b0 = h0 = jnp.uint32(0)
+    else:
+        b0 = jnp.asarray(bh0[0], jnp.uint32)
+        h0 = jnp.asarray(bh0[1], jnp.uint32)
+    return jnp.stack([seed.reshape(()), b0.reshape(()), h0.reshape(())])
+
+
+def _bh_from(s_ref, n, h_loc: int, h_glob: int):
+    """GLOBAL batch*head dropout stream index for local flattened
+    instance ``n`` inside a kernel: (b0 + n//h_loc)*h_glob + h0 +
+    n%h_loc, with (b0, h0) read from the packed seed operand.  The
+    global index keeps the hash-dropout masks placement-invariant when
+    the heads are sharded over tp (kernel_shard.flash_attention_sharded)
+    — the same contract ops/fused_ffn.py keeps for sharded rows."""
+    b0 = s_ref[0, 1].astype(jnp.int32)
+    h0 = s_ref[0, 2].astype(jnp.int32)
+    return (b0 + n // h_loc) * h_glob + h0 + n % h_loc
+
+
+def _bh_array(B: int, H: int, seed3: jax.Array, h_glob: int) -> jax.Array:
+    """[B,H,1,1] global stream indices — the XLA-path twin of _bh_from
+    (blockwise/dense fallbacks take the whole index array at once)."""
+    b0 = seed3[1].astype(jnp.int32)
+    h0 = seed3[2].astype(jnp.int32)
+    return ((b0 + jnp.arange(B, dtype=jnp.int32))[:, None] * h_glob
+            + h0 + jnp.arange(H, dtype=jnp.int32)[None, :])[:, :, None, None]
+
+
 def _bias_operand(key_bias, n_heads: int, lk: int):
     """(bias operand, index_map, has_bias) for the MONOLITHIC kernels.
 
@@ -107,14 +145,18 @@ def _bias_operand(key_bias, n_heads: int, lk: int):
 def _flash_fwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
                       key_bias: Optional[jax.Array], n_heads: int,
                       block_q: int, dropout_rate: float = 0.0,
-                      dropout_seed: Optional[jax.Array] = None,
-                      emit_lse: bool = False):
+                      seed3: Optional[jax.Array] = None,
+                      emit_lse: bool = False,
+                      h_glob: Optional[int] = None):
     """q/k/v [N, L, D] (N = B·H), key_bias [B, Lk] additive or None
     (heads share their batch row via the bias index map — no H-repeat).
 
     dropout_rate > 0 applies ops.attention.dropout_keep in-kernel: the
-    keep mask is a pure hash of (seed, n, global q row, k col), so the
-    recompute backward regenerates it exactly without any HBM mask.
+    keep mask is a pure hash of (seed, GLOBAL bh, global q row, k col)
+    — seed3 is the _pack_seed [seed, b0, h0] operand and h_glob the
+    global head count, so head-sharded shards regenerate the exact
+    single-device mask — and the recompute backward regenerates it
+    exactly without any HBM mask.
 
     emit_lse=True additionally returns the row lse [N, Lq] fp32 (stored
     at _KB_LANES lanes like the K-blocked kernels, sliced outside) so
@@ -134,8 +176,9 @@ def _flash_fwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
     if pad_q:
         q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0)))
     bias, bias_map, has_bias = _bias_operand(key_bias, n_heads, Lk)
-    seed = (dropout_seed if dropout_seed is not None
-            else jnp.uint32(0)).reshape(1, 1).astype(jnp.uint32)
+    seed = (seed3 if seed3 is not None
+            else _pack_seed(None)).reshape(1, 3).astype(jnp.uint32)
+    hg = h_glob if h_glob is not None else n_heads
 
     def kernel(q_ref, k_ref, v_ref, b_ref, s_ref, o_ref, *lse_ref):
         qb = q_ref[0]                                   # [block_q, D]
@@ -148,11 +191,11 @@ def _flash_fwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
         p = jnp.exp(s - m)
         l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
         if dropout_rate > 0.0:
-            n = pl.program_id(0)
+            bh = _bh_from(s_ref, pl.program_id(0), n_heads, hg)
             qrow = (pl.program_id(1) * block_q
                     + jax.lax.broadcasted_iota(jnp.int32, (block_q, Lk), 0))
             kcol = jax.lax.broadcasted_iota(jnp.int32, (block_q, Lk), 1)
-            p = p * dropout_keep(s_ref[0, 0], n, qrow, kcol, dropout_rate)
+            p = p * dropout_keep(s_ref[0, 0], bh, qrow, kcol, dropout_rate)
         ctx = jnp.dot(p.astype(v_ref.dtype), v_ref[0],
                       preferred_element_type=jnp.float32)
         o_ref[0] = (ctx / l).astype(o_ref.dtype)
@@ -246,13 +289,15 @@ def _kb_pad(q, k, v, key_bias, bq, bk):
 
 def _flash_fwd_kblocked(q: jax.Array, k: jax.Array, v: jax.Array,
                         key_bias, dropout_rate: float = 0.0,
-                        dropout_seed=None):
+                        seed3=None, n_heads: int = 1,
+                        h_glob: Optional[int] = None):
     """q/k/v [N, L, D] (N = B·H).  Returns (out [N, Lq, D],
     lse [N, Lq] fp32).  Grid (N, q-block, k-block), k innermost;
     running (m, l, acc) in VMEM scratch; out and lse written on the
     last k step.  l accumulates PRE-dropout probability mass (softmax-
     then-dropout semantics, transformer.py:190-192), dropout applies to
-    the value contraction only — matching every other impl."""
+    the value contraction only — matching every other impl.  seed3 /
+    n_heads / h_glob: the _pack_seed global-bh dropout convention."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -262,8 +307,9 @@ def _flash_fwd_kblocked(q: jax.Array, k: jax.Array, v: jax.Array,
     scale = 1.0 / math.sqrt(D)
     bq, bk = _kb_blocks(Lq, k.shape[1])
     q, k, v, bias, nq, nk = _kb_pad(q, k, v, key_bias, bq, bk)
-    seed = (dropout_seed if dropout_seed is not None
-            else jnp.uint32(0)).reshape(1, 1).astype(jnp.uint32)
+    seed = (seed3 if seed3 is not None
+            else _pack_seed(None)).reshape(1, 3).astype(jnp.uint32)
+    hg = h_glob if h_glob is not None else n_heads
     kreps = bk // _KB_LANES
 
     def kernel(q_ref, k_ref, v_ref, b_ref, s_ref, o_ref, lse_ref,
@@ -287,10 +333,10 @@ def _flash_fwd_kblocked(q: jax.Array, k: jax.Array, v: jax.Array,
         alpha = jnp.exp(m_prev - m_next)
         l_next = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         if dropout_rate > 0.0:
-            n = pl.program_id(0)
+            bh = _bh_from(s_ref, pl.program_id(0), n_heads, hg)
             qrow = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kcol = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            p = p * dropout_keep(s_ref[0, 0], n, qrow, kcol, dropout_rate)
+            p = p * dropout_keep(s_ref[0, 0], bh, qrow, kcol, dropout_rate)
         acc_scr[...] = (acc_scr[...] * _lanes_to(alpha, D)
                         + jnp.dot(p.astype(v_ref.dtype), v_ref[0],
                                   preferred_element_type=jnp.float32))
@@ -330,8 +376,8 @@ def _flash_fwd_kblocked(q: jax.Array, k: jax.Array, v: jax.Array,
     return out[:, :Lq], lse[:, :Lq, 0]
 
 
-def _flash_bwd_kblocked(q, k, v, key_bias, dropout_seed, dropout_rate,
-                        out, lse):
+def _flash_bwd_kblocked(q, k, v, key_bias, seed3, dropout_rate,
+                        out, lse, h_glob: Optional[int] = None):
     """FA-2-style backward: two k-blocked kernels (dq over the q-grid,
     dk/dv over the k-grid), both O(tile) VMEM — no Lk cap.  Uses the
     forward-saved lse, so probabilities come back exactly normalized
@@ -352,8 +398,9 @@ def _flash_bwd_kblocked(q, k, v, key_bias, dropout_seed, dropout_rate,
     bq, bk = _kb_blocks(Lq, Lk)
     qp, kp, vp, bias, nq, nk = _kb_pad(qn, kn, vn, kb, bq, bk)
     Lqp = nq * bq
-    seed = (dropout_seed if dropout_seed is not None
-            else jnp.uint32(0)).reshape(1, 1).astype(jnp.uint32)
+    seed = (seed3 if seed3 is not None
+            else _pack_seed(None)).reshape(1, 3).astype(jnp.uint32)
+    hg = h_glob if h_glob is not None else H
     kreps = bk // _KB_LANES
 
     def pad_q_rows(x):
@@ -385,10 +432,10 @@ def _flash_bwd_kblocked(q, k, v, key_bias, dropout_seed, dropout_rate,
             do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # [bq, bk]
         if dropout_rate > 0.0:
-            n = pl.program_id(0)
+            bh = _bh_from(s_ref, pl.program_id(0), H, hg)
             qrow = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kcol = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            dpterm = dpterm * dropout_keep(s_ref[0, 0], n, qrow, kcol,
+            dpterm = dpterm * dropout_keep(s_ref[0, 0], bh, qrow, kcol,
                                            dropout_rate)
         ds = p * (dpterm - jnp.tile(dl_ref[0], (1, kreps))) * scale
         dq_scr[...] += jnp.dot(ds.astype(k_ref.dtype), k_ref[0],
@@ -413,10 +460,10 @@ def _flash_bwd_kblocked(q, k, v, key_bias, dropout_seed, dropout_rate,
             do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # [bq, bk]
         if dropout_rate > 0.0:
-            n = pl.program_id(0)
+            bh = _bh_from(s_ref, pl.program_id(0), H, hg)
             qrow = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kcol = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            keep = dropout_keep(s_ref[0, 0], n, qrow, kcol, dropout_rate)
+            keep = dropout_keep(s_ref[0, 0], bh, qrow, kcol, dropout_rate)
             pt = p * keep
             dpterm = dpterm * keep
         else:
@@ -493,11 +540,11 @@ def _flash_bwd_kblocked(q, k, v, key_bias, dropout_seed, dropout_rate,
     return run
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _flash_core(q, k, v, key_bias, dropout_seed, block_q, dropout_rate,
-                save_stats):
-    return _flash_impl(q, k, v, key_bias, dropout_seed, block_q,
-                       dropout_rate)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_core(q, k, v, key_bias, seed3, block_q, dropout_rate,
+                save_stats, h_glob):
+    return _flash_impl(q, k, v, key_bias, seed3, block_q,
+                       dropout_rate, h_glob)
 
 
 def _fwd_kernel_fits(block_q: int, lk: int, d: int = 64) -> bool:
@@ -517,7 +564,8 @@ def _shrink_block_q(block_q: int, lk: int, d: int) -> int:
     return block_q
 
 
-def _flash_impl(q, k, v, key_bias, dropout_seed, block_q, dropout_rate):
+def _flash_impl(q, k, v, key_bias, seed3, block_q, dropout_rate,
+                h_glob=None):
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     block_q = _shrink_block_q(block_q, Lk, D)
@@ -525,19 +573,23 @@ def _flash_impl(q, k, v, key_bias, dropout_seed, block_q, dropout_rate):
         n3 = lambda x: x.reshape(B * H, x.shape[2], x.shape[3])  # noqa: E731
         if _fwd_kernel_fits(block_q, Lk, D):
             out = _flash_fwd_pallas(n3(q), n3(k), n3(v), key_bias, H,
-                                    block_q, dropout_rate, dropout_seed)
+                                    block_q, dropout_rate, seed3,
+                                    h_glob=h_glob)
             return out.reshape(B, H, Lq, D)
         if _kblocked_supported(D):
             kb = (jnp.repeat(key_bias, H, axis=0)
                   if key_bias is not None else None)
             out, _ = _flash_fwd_kblocked(n3(q), n3(k), n3(v), kb,
-                                         dropout_rate, dropout_seed)
+                                         dropout_rate, seed3,
+                                         n_heads=H, h_glob=h_glob)
             return out.reshape(B, H, Lq, D)
     mask = None
     if key_bias is not None:
         mask = (key_bias > NEG_INF / 2).astype(jnp.int32)[:, None, None, :]
-    return blockwise_attention(q, k, v, mask, dropout_rate=dropout_rate,
-                               dropout_seed=dropout_seed)
+    seed3 = seed3 if seed3 is not None else _pack_seed(None)
+    return blockwise_attention(
+        q, k, v, mask, dropout_rate=dropout_rate, dropout_seed=seed3[0],
+        dropout_bh=_bh_array(B, H, seed3, h_glob or H))
 
 
 def _save_stats_enabled(save_stats=None) -> bool:
@@ -551,8 +603,8 @@ def _save_stats_enabled(save_stats=None) -> bool:
     return os.environ.get("FDT_FLASH_SAVE_STATS", "1") != "0"
 
 
-def _flash_fwd(q, k, v, key_bias, dropout_seed, block_q, dropout_rate,
-               save_stats):
+def _flash_fwd(q, k, v, key_bias, seed3, block_q, dropout_rate,
+               save_stats, h_glob):
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     pallas_bwd = (_use_pallas()
@@ -566,9 +618,10 @@ def _flash_fwd(q, k, v, key_bias, dropout_seed, block_q, dropout_rate,
         kb = (jnp.repeat(key_bias, H, axis=0)
               if key_bias is not None else None)
         out, lse = _flash_fwd_kblocked(n3(q), n3(k), n3(v), kb,
-                                       dropout_rate, dropout_seed)
+                                       dropout_rate, seed3,
+                                       n_heads=H, h_glob=h_glob)
         out = out.reshape(B, H, Lq, D)
-        return out, (q, k, v, key_bias, dropout_seed, out, lse)
+        return out, (q, k, v, key_bias, seed3, out, lse)
     # Monolithic-envelope autodiff (VERDICT r5 #3, the flash-routed
     # bs64/seq512 shape): emit the row lse from the forward so the
     # monolithic backward skips its in-kernel softmax recompute AND the
@@ -583,13 +636,13 @@ def _flash_fwd(q, k, v, key_bias, dropout_seed, block_q, dropout_rate,
             and _fwd_kernel_fits(bq, Lk, D)):
         n3 = lambda x: x.reshape(B * H, x.shape[2], x.shape[3])  # noqa: E731
         out, lse = _flash_fwd_pallas(n3(q), n3(k), n3(v), key_bias, H, bq,
-                                     dropout_rate, dropout_seed,
-                                     emit_lse=True)
+                                     dropout_rate, seed3,
+                                     emit_lse=True, h_glob=h_glob)
         out = out.reshape(B, H, Lq, D)
-        return out, (q, k, v, key_bias, dropout_seed, out, lse)
-    return (_flash_impl(q, k, v, key_bias, dropout_seed, block_q,
-                        dropout_rate),
-            (q, k, v, key_bias, dropout_seed, None, None))
+        return out, (q, k, v, key_bias, seed3, out, lse)
+    return (_flash_impl(q, k, v, key_bias, seed3, block_q,
+                        dropout_rate, h_glob),
+            (q, k, v, key_bias, seed3, None, None))
 
 
 # Backward-policy budget for the DENSE-VJP branch.  The dense backward
@@ -660,8 +713,8 @@ def _bwd_block_q_stats(lq: int, lk: int) -> int:
     return 64
 
 
-def _flash_bwd_pallas_stats(q, k, v, key_bias, dropout_seed, dropout_rate,
-                            out, lse):
+def _flash_bwd_pallas_stats(q, k, v, key_bias, seed3, dropout_rate,
+                            out, lse, h_glob: Optional[int] = None):
     """Monolithic saved-stats backward (the L=512 retune, VERDICT r5
     #3): K/V stay VMEM-resident like _flash_bwd_pallas, but the softmax
     is NOT recomputed — probabilities come back exactly normalized from
@@ -684,8 +737,9 @@ def _flash_bwd_pallas_stats(q, k, v, key_bias, dropout_seed, dropout_rate,
     nq3 = lambda x: x.reshape(N, x.shape[2], x.shape[3])  # noqa: E731
     qn, kn, vn, on = nq3(q), nq3(k), nq3(v), nq3(out)
     bias, bias_map, has_bias = _bias_operand(key_bias, H, Lk)
-    seed = (dropout_seed if dropout_seed is not None
-            else jnp.uint32(0)).reshape(1, 1).astype(jnp.uint32)
+    seed = (seed3 if seed3 is not None
+            else _pack_seed(None)).reshape(1, 3).astype(jnp.uint32)
+    hg = h_glob if h_glob is not None else H
 
     bq = _bwd_block_q_stats(Lq, Lk)
     nq = -(-Lq // bq)
@@ -717,11 +771,11 @@ def _flash_bwd_pallas_stats(q, k, v, key_bias, dropout_seed, dropout_rate,
             do, vv.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, Lk]
         if dropout_rate > 0.0:
-            n = pl.program_id(0)
+            bh = _bh_from(s_ref, pl.program_id(0), H, hg)
             qrow = (i * bq
                     + jax.lax.broadcasted_iota(jnp.int32, (bq, Lk), 0))
             kcol = jax.lax.broadcasted_iota(jnp.int32, (bq, Lk), 1)
-            keep = dropout_keep(s_ref[0, 0], n, qrow, kcol, dropout_rate)
+            keep = dropout_keep(s_ref[0, 0], bh, qrow, kcol, dropout_rate)
             pt = p * keep
             dpterm = dpterm * keep
         else:
@@ -790,8 +844,8 @@ def _flash_bwd_pallas_stats(q, k, v, key_bias, dropout_seed, dropout_rate,
     return run
 
 
-def _flash_bwd_pallas(q, k, v, key_bias, dropout_seed, dropout_rate,
-                      block_q):
+def _flash_bwd_pallas(q, k, v, key_bias, seed3, dropout_rate,
+                      block_q, h_glob: Optional[int] = None):
     """Pallas backward kernel: dq/dk/dv with softmax stats RECOMPUTED
     per q-block inside the kernel (K/V stay VMEM-resident, so the full
     [block_q, Lk] score row costs one MXU matmul — no saved lse needed
@@ -819,8 +873,9 @@ def _flash_bwd_pallas(q, k, v, key_bias, dropout_seed, dropout_rate,
     qn, kn, vn = nq3(q), nq3(k), nq3(v)
 
     bias, bias_map, has_bias = _bias_operand(key_bias, H, Lk)
-    seed = (dropout_seed if dropout_seed is not None
-            else jnp.uint32(0)).reshape(1, 1).astype(jnp.uint32)
+    seed = (seed3 if seed3 is not None
+            else _pack_seed(None)).reshape(1, 3).astype(jnp.uint32)
+    hg = h_glob if h_glob is not None else H
 
     # backward holds ~4 score-shaped fp32 tiles (s/p, dpterm, ds, keep):
     # budget the q-tile so tiles + the resident K/V stay inside the
@@ -846,11 +901,11 @@ def _flash_bwd_pallas(q, k, v, key_bias, dropout_seed, dropout_rate,
         p = jnp.exp(s - m)
         l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
         if dropout_rate > 0.0:
-            n = pl.program_id(0)
+            bh = _bh_from(s_ref, pl.program_id(0), H, hg)
             qrow = (i * bq
                     + jax.lax.broadcasted_iota(jnp.int32, (bq, Lk), 0))
             kcol = jax.lax.broadcasted_iota(jnp.int32, (bq, Lk), 1)
-            keep = dropout_keep(s_ref[0, 0], n, qrow, kcol, dropout_rate)
+            keep = dropout_keep(s_ref[0, 0], bh, qrow, kcol, dropout_rate)
             pt = p * keep
         else:
             keep = None
@@ -920,8 +975,8 @@ def _flash_bwd_pallas(q, k, v, key_bias, dropout_seed, dropout_rate,
     return run
 
 
-def _flash_bwd(block_q, dropout_rate, save_stats, res, g):
-    q, k, v, key_bias, dropout_seed, out, lse = res
+def _flash_bwd(block_q, dropout_rate, save_stats, h_glob, res, g):
+    q, k, v, key_bias, seed3, out, lse = res
     mask = None
     if key_bias is not None:
         mask = (key_bias > NEG_INF / 2).astype(jnp.int32)[:, None, None, :]
@@ -936,14 +991,15 @@ def _flash_bwd(block_q, dropout_rate, save_stats, res, g):
         # from the monolithic kernel, so the monolithic backward skips
         # its in-kernel softmax/out recompute (the L=512 retune)
         dq, dk, dv = _flash_bwd_pallas_stats(q, k, v, key_bias,
-                                             dropout_seed, dropout_rate,
-                                             out, lse)(g)
+                                             seed3, dropout_rate,
+                                             out, lse, h_glob=h_glob)(g)
     elif out is not None:
         # the forward took the k-blocked route (monolithic envelope
         # exceeded) and saved (out, lse): finish with the k-blocked
         # FA-2-style kernels — no Lk cap, O(tile) VMEM
-        dq, dk, dv = _flash_bwd_kblocked(q, k, v, key_bias, dropout_seed,
-                                         dropout_rate, out, lse)(g)
+        dq, dk, dv = _flash_bwd_kblocked(q, k, v, key_bias, seed3,
+                                         dropout_rate, out, lse,
+                                         h_glob=h_glob)(g)
     elif (_use_pallas() and os.environ.get("FDT_DISABLE_PALLAS_BWD") != "1"
             and _bwd_kernel_fits(Lq, Lk, D)):
         # On TPU the monolithic backward kernel wins at EVERY measured
@@ -953,24 +1009,27 @@ def _flash_bwd(block_q, dropout_rate, save_stats, res, g):
         # while keeping O(L·block) memory — so it is the default inside
         # the envelope; the k-blocked branch above covers everything
         # beyond it.
-        dq, dk, dv = _flash_bwd_pallas(q, k, v, key_bias, dropout_seed,
-                                       dropout_rate, block_q)(g)
-    elif 3 * scores_bytes <= _dense_bwd_budget_bytes():
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: dense_attention_reference(
-                q_, k_, v_, mask, dropout_rate=dropout_rate,
-                dropout_seed=dropout_seed),
-            q, k, v)
-        dq, dk, dv = vjp(g)
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, key_bias, seed3,
+                                       dropout_rate, block_q,
+                                       h_glob=h_glob)(g)
     else:
-        # long context off-TPU: recompute-in-backward via the blockwise
-        # formulation keeps peak memory O(L*block) at the price of the
-        # scan recompute
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: blockwise_attention(
-                q_, k_, v_, mask, dropout_rate=dropout_rate,
-                dropout_seed=dropout_seed),
-            q, k, v)
+        seed0 = (seed3 if seed3 is not None else _pack_seed(None))
+        bh = _bh_array(B, H, seed0, h_glob or H)
+        if 3 * scores_bytes <= _dense_bwd_budget_bytes():
+            _, vjp = jax.vjp(
+                lambda q_, k_, v_: dense_attention_reference(
+                    q_, k_, v_, mask, dropout_rate=dropout_rate,
+                    dropout_seed=seed0[0], dropout_bh=bh),
+                q, k, v)
+        else:
+            # long context off-TPU: recompute-in-backward via the
+            # blockwise formulation keeps peak memory O(L*block) at the
+            # price of the scan recompute
+            _, vjp = jax.vjp(
+                lambda q_, k_, v_: blockwise_attention(
+                    q_, k_, v_, mask, dropout_rate=dropout_rate,
+                    dropout_seed=seed0[0], dropout_bh=bh),
+                q, k, v)
         dq, dk, dv = vjp(g)
     return dq, dk, dv, None, None
 
@@ -996,7 +1055,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_q: Optional[int] = None,
                     dropout_rate: float = 0.0,
                     dropout_seed: Optional[jax.Array] = None,
-                    save_stats: Optional[bool] = None) -> jax.Array:
+                    save_stats: Optional[bool] = None,
+                    bh0=None,
+                    h_glob: Optional[int] = None) -> jax.Array:
     """Drop-in for dense_attention (models/transformer.py:101-111),
     INCLUDING attention-prob dropout (transformer.py:190-192): the keep
     mask is an index hash (ops.attention.dropout_keep) computed inside
@@ -1015,6 +1076,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     attn_out/dots policies): out/lse residuals would force the forward
     kernel to re-run in the replay, whereas the recompute backward's
     input-only residuals let XLA DCE the replayed kernel entirely.
+    bh0/h_glob: head-sharded callers (parallel/kernel_shard.py running
+    this kernel per-shard under shard_map) pass their GLOBAL (batch,
+    head) shard origin and the global head count so the in-kernel
+    dropout hashes GLOBAL stream indices — masks stay placement-
+    invariant; the defaults reduce to the local indices bit-for-bit.
     """
     if block_q is None:
         block_q = _auto_block_q(q.shape[2], k.shape[2])
@@ -1025,7 +1091,6 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             kb = kb.reshape(kb.shape[0], kb.shape[-1])
         kb = jnp.broadcast_to(kb, (q.shape[0], k.shape[2]))
         key_bias = mask_to_bias(kb)
-    seed = (jnp.uint32(0) if dropout_seed is None
-            else dropout_seed.astype(jnp.uint32))
-    return _flash_core(q, k, v, key_bias, seed, block_q,
-                       float(dropout_rate), save_stats)
+    return _flash_core(q, k, v, key_bias, _pack_seed(dropout_seed, bh0),
+                       block_q, float(dropout_rate), save_stats,
+                       h_glob if h_glob is not None else int(q.shape[1]))

@@ -303,6 +303,19 @@ def _ffn_vjp_bwd(rate_hidden, rate_conn, eps, l_loc, l_glob, res, g):
 _ffn_core.defvjp(_ffn_vjp_fwd, _ffn_vjp_bwd)
 
 
+def pack_scales(quant_scales) -> jax.Array:
+    """THE (4,) fp32 scales operand every fused-FFN shard_map layer
+    ships to the generalized kernel: [sx1, sw1, sx2, sw2] stacked from
+    traced scalars, or zeros(4) when quantization is off (None).  One
+    definition so fused_ffn_sublayer_sharded, ffn_core_generalized and
+    parallel/kernel_shard.fused_ffn_sublayer_tp can never disagree on
+    the operand layout."""
+    if quant_scales is None:
+        return jnp.zeros((4,), jnp.float32)
+    return jnp.stack([jnp.asarray(s, jnp.float32).reshape(())
+                      for s in quant_scales])
+
+
 def fused_ffn_sublayer(h, ln_scale, ln_bias, w1, b1, w2, b2,
                        hid_seed, out_seed,
                        rate_hidden: float = 0.0, rate_conn: float = 0.0,
@@ -331,7 +344,10 @@ def fused_ffn_sublayer_sharded(h, ln_scale, ln_bias, w1, b1, w2, b2,
                                hid_seed, out_seed, mesh,
                                rate_hidden: float = 0.0,
                                rate_conn: float = 0.0,
-                               eps: float = 1e-6):
+                               eps: float = 1e-6,
+                               quant_fmt: Optional[str] = None,
+                               quant_scales=None,
+                               grad_fmt: Optional[str] = None):
     """SPMD wrapper: the kernel runs PER SHARD under ``jax.shard_map``
     over the mesh's data axes (batch over dp/fsdp, sequence over sp),
     weights replicated (an fsdp/ZeRO-3-sharded weight is all-gathered by
@@ -344,9 +360,14 @@ def fused_ffn_sublayer_sharded(h, ln_scale, ln_bias, w1, b1, w2, b2,
     masks on dp=1, dp=4 or dp=8, bit-for-bit.  The global index space is
     uint32 — the contract holds up to 2^32 elements per activation
     tensor (see ops.dropout.keep_factor_rows for the documented wrap
-    behavior past it).  tp-sharded FFN weights remain unsupported
-    (build_model falls back — gathering tensor-parallel weights per
-    step would defeat tp)."""
+    behavior past it).  tp-SHARDED FFN weights take the Megatron
+    column-then-row decomposition in parallel/kernel_shard.py instead
+    (this wrapper keeps the weights replicated).
+
+    ``quant_fmt``/``quant_scales``/``grad_fmt`` (r19): run the two GEMMs
+    quantized in-kernel through the generalized core; returns
+    ``(out, amax2)`` with amax2 the GLOBAL (2,) [amax_f, amax_a] for the
+    delayed-scaling history roll (pmax'd over every sharded axis)."""
     from jax.sharding import PartitionSpec as P
 
     batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names
@@ -354,6 +375,12 @@ def fused_ffn_sublayer_sharded(h, ln_scale, ln_bias, w1, b1, w2, b2,
     seq_axis = "sp" if ("sp" in mesh.axis_names
                         and mesh.shape["sp"] > 1) else None
     if not batch_axes and seq_axis is None:
+        if quant_fmt is not None:
+            return ffn_core_generalized(
+                h, ln_scale, ln_bias, w1, b1, w2, b2, hid_seed, out_seed,
+                0, 0, 0, rate_hidden, rate_conn, eps, 1, 1,
+                dff_glob=int(w1.shape[1]), quant_fmt=quant_fmt,
+                quant_scales=quant_scales, grad_fmt=grad_fmt)
         return fused_ffn_sublayer(h, ln_scale, ln_bias, w1, b1, w2, b2,
                                   hid_seed, out_seed, rate_hidden,
                                   rate_conn, eps)
@@ -373,8 +400,9 @@ def fused_ffn_sublayer_sharded(h, ln_scale, ln_bias, w1, b1, w2, b2,
                   seq_axis, None)
     rep = P(None)
     sp_size = mesh.shape[seq_axis] if seq_axis else 1
+    sharded_axes = batch_axes + ((seq_axis,) if seq_axis else ())
 
-    def per_shard(h_, lns_, lnb_, w1_, b1_, w2_, b2_, s1_, s2_):
+    def per_shard(h_, lns_, lnb_, w1_, b1_, w2_, b2_, s1_, s2_, scales_):
         b_loc, l_loc = h_.shape[0], h_.shape[1]
         bi = jnp.uint32(0)
         for ax in batch_axes:
@@ -383,17 +411,376 @@ def fused_ffn_sublayer_sharded(h, ln_scale, ln_bias, w1, b1, w2, b2,
         b0 = bi * jnp.uint32(b_loc)
         s0 = (jax.lax.axis_index(seq_axis).astype(jnp.uint32)
               * jnp.uint32(l_loc) if seq_axis else jnp.uint32(0))
-        return _ffn_core(h_, lns_, lnb_, w1_, b1_, w2_, b2_, s1_, s2_,
-                         b0, s0, rate_hidden, rate_conn, eps,
-                         l_loc, l_loc * sp_size)
+        if quant_fmt is None:
+            out = _ffn_core(h_, lns_, lnb_, w1_, b1_, w2_, b2_, s1_, s2_,
+                            b0, s0, rate_hidden, rate_conn, eps,
+                            l_loc, l_loc * sp_size)
+            return out, jnp.zeros((2,), jnp.float32)
+        qscales = tuple(scales_[i] for i in range(4))
+        out, amax2 = ffn_core_generalized(
+            h_, lns_, lnb_, w1_, b1_, w2_, b2_, s1_, s2_, b0, s0, 0,
+            rate_hidden, rate_conn, eps, l_loc, l_loc * sp_size,
+            dff_glob=int(w1_.shape[1]), quant_fmt=quant_fmt,
+            quant_scales=qscales, grad_fmt=grad_fmt,
+            grad_axes=sharded_axes)
+        # globalize the per-tensor amaxes: every shard sees a slice of
+        # the same logical tensors, so the (2,) output is pmax'd over
+        # every sharded axis and leaves the boundary truly replicated.
+        # stop_gradient first: amaxes feed the scale-history roll, not
+        # the loss, and pmax has no differentiation rule
+        amax2 = jax.lax.stop_gradient(amax2)
+        for ax in sharded_axes:
+            amax2 = jax.lax.pmax(amax2, ax)
+        return out, amax2
 
     from faster_distributed_training_tpu.compat import shard_map
-    return shard_map(
+    out, amax2 = shard_map(
         per_shard, mesh=mesh,
-        in_specs=(data_spec, rep, rep, rep, rep, rep, rep, P(), P()),
-        out_specs=data_spec,
+        in_specs=(data_spec, rep, rep, rep, rep, rep, rep, P(), P(), P()),
+        out_specs=(data_spec, P()),
         # the pallas_call's out_shape carries no varying-mesh-axes info,
         # so VMA checking cannot see through it
         check_vma=False,
     )(h, ln_scale, ln_bias, w1, b1, w2, b2,
-      jnp.asarray(hid_seed, jnp.uint32), jnp.asarray(out_seed, jnp.uint32))
+      jnp.asarray(hid_seed, jnp.uint32), jnp.asarray(out_seed, jnp.uint32),
+      pack_scales(quant_scales if quant_fmt is not None else None))
+    if quant_fmt is None:
+        return out
+    return out, amax2
+
+
+# ---------------------------------------------------------------------------
+# r19: the generalized core behind the shard_map kernel layer
+# (parallel/kernel_shard.py) and the quantized fused-FFN composition.
+#
+# Two orthogonal extensions of the kernel above, parameterized statically
+# so they compose (quant x partial x column offsets):
+#   * quant (fmt != None) — the two GEMMs run on int8/fp8 operands with
+#     per-tensor delayed scales (ops/quant.py recipe): the x side (LN
+#     output / hidden activation) is quantized IN-KERNEL at the delayed
+#     scale, the weights arrive pre-quantized, and the kernel emits the
+#     two current-step amaxes (max-accumulated across the row-block
+#     grid) so the caller can roll the histories — recombining the
+#     LN/dropout fusion with the r13 quantized GEMMs (the kernel was
+#     bf16-only under quant before this).
+#   * partial (Megatron column-then-row tp tile) — w1 is a COLUMN shard
+#     [d, d_ff/tp], w2 the matching ROW shard [d_ff/tp, d]; the kernel
+#     computes LN -> GEMM1 -> GELU -> hidden dropout (addressing global
+#     d_ff columns via c0/dff_glob) -> GEMM2 and stops BEFORE b2 / the
+#     connection dropout / the residual, emitting the fp32 partial the
+#     wrapper psums over tp — exactly ONE collective per sublayer, no
+#     per-step weight gather.
+#
+# The backward for every combination is jax.vjp of ONE pure-XLA oracle
+# (_ffn_body_reference) with the kernel's exact op order; the quant
+# GEMMs inside it are ops.quant.quant_dot custom_vjp calls, so the
+# straight-through estimator (and the optional fp8-E5M2 quantized
+# gradient GEMMs) come along by construction.
+# ---------------------------------------------------------------------------
+
+
+def _ffn_body_reference(h, ln_scale, ln_bias, w1, b1, w2, b2,
+                        hid_seed, out_seed, rate_hidden, rate_conn, eps,
+                        b0, s0, l_loc, l_glob, c0=0, dff_glob=0,
+                        partial=False, quant=None, return_amax=False):
+    """The generalized pure-XLA oracle (op order == the generalized
+    kernel).  ``quant``: None or (fmt, sx1, sw1, sx2, sw2, grad_fmt,
+    grad_axes) — scales are traced scalars, the rest static.  partial:
+    stop before b2/connection-dropout/residual and return the fp32
+    GEMM2 product.  return_amax: also return the (2,) [amax_f, amax_a]
+    current-step amaxes (zeros when quant is None)."""
+    from faster_distributed_training_tpu.ops.quant import (quant_dot,
+                                                           tensor_amax)
+
+    lead = h.shape[:-1]
+    d = h.shape[-1]
+    x32 = h.reshape(-1, d).astype(jnp.float32)
+    n_rows = x32.shape[0]
+    grows = _global_rows(lax.iota(jnp.uint32, n_rows), b0, s0, l_loc, l_glob)
+    f = _ln_saved(x32, ln_scale.astype(jnp.float32),
+                  ln_bias.astype(jnp.float32), eps).astype(h.dtype)
+    amax_f = amax_a = jnp.float32(0.0)
+    if quant is not None:
+        fmt, sx1, sw1, sx2, sw2, gfmt, gaxes = quant
+        if return_amax:
+            amax_f = tensor_amax(f)
+        h1 = quant_dot(f, w1, sx1, sw1, fmt, use_pallas=False,
+                       grad_fmt=gfmt, grad_axes=gaxes
+                       ).astype(jnp.float32) + b1.astype(jnp.float32)
+    else:
+        h1 = jnp.dot(f, w1, preferred_element_type=jnp.float32) \
+            + b1.astype(jnp.float32)
+    a = _gelu_f32(h1)
+    if rate_hidden > 0.0:
+        a = a * _keep_rows(hid_seed, grows, a.shape[1], rate_hidden,
+                           c0, dff_glob)
+    a = a.astype(h.dtype)
+    if quant is not None:
+        if return_amax:
+            amax_a = tensor_amax(a)
+        f2 = quant_dot(a, w2, sx2, sw2, fmt, use_pallas=False,
+                       grad_fmt=gfmt, grad_axes=gaxes).astype(jnp.float32)
+    else:
+        f2 = jnp.dot(a, w2, preferred_element_type=jnp.float32)
+    if partial:
+        out = f2.reshape(*lead, d)
+    else:
+        f2 = f2 + b2.astype(jnp.float32)
+        if rate_conn > 0.0:
+            f2 = f2 * _keep_rows(out_seed, grows, f2.shape[1], rate_conn)
+        out = (x32 + f2).astype(h.dtype).reshape(*lead, d)
+    if return_amax:
+        return out, jnp.stack([amax_f, amax_a])
+    return out
+
+
+def _ffn_kernel2(h_ref, lns_ref, lnb_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+                 seeds_ref, scales_ref, o_ref, *amax_refs, block_rows: int,
+                 rate_hidden: float, rate_conn: float, eps: float,
+                 l_loc: int, l_glob: int, dff_glob: int, fmt,
+                 partial: bool):
+    """The generalized row-block kernel (see the section comment).
+    seeds_ref (1, 5) SMEM u32: [hid_seed, out_seed, b0, s0, c0];
+    scales_ref (1, 4) fp32: the RAW delayed scales [sx1, sw1, sx2, sw2]
+    (quant only) — the kernel derives each GEMM's descale 1/(sx·sw)
+    itself, callers never pass precomputed inverses."""
+    from faster_distributed_training_tpu.ops.quant import QMAX
+
+    row0 = pl.program_id(0) * block_rows
+    x32 = h_ref[...].astype(jnp.float32)
+    rows = x32.shape[0]
+    f = _ln_f32(x32, lns_ref[...].astype(jnp.float32),
+                lnb_ref[...].astype(jnp.float32), eps).astype(h_ref.dtype)
+
+    def qdot(x, wq_ref, sx, inv):
+        # mirror ops.quant.quant_dot's round-trip exactly: quantize the
+        # compute-dtype operand, contract, descale in fp32, ONE cast to
+        # the compute dtype, upcast f32 for the bias/GELU chain
+        xs = x.astype(jnp.float32) * sx
+        if fmt == "int8":
+            xq = jnp.clip(jnp.round(xs), -QMAX["int8"],
+                          QMAX["int8"]).astype(jnp.int8)
+            acc = jax.lax.dot(xq, wq_ref[...],
+                              preferred_element_type=jnp.int32
+                              ).astype(jnp.float32)
+        else:
+            qmax = QMAX["fp8"]
+            xq = jnp.clip(xs, -qmax, qmax).astype(jnp.float8_e4m3fn)
+            acc = jax.lax.dot(xq.astype(jnp.float32),
+                              wq_ref[...].astype(jnp.float32),
+                              preferred_element_type=jnp.float32)
+        return (acc * inv).astype(h_ref.dtype).astype(jnp.float32)
+
+    if fmt is not None:
+        amax_blk_f = jnp.max(jnp.abs(f.astype(jnp.float32)))
+        h1 = qdot(f, w1_ref, scales_ref[0, 0],
+                  1.0 / (scales_ref[0, 0] * scales_ref[0, 1])) \
+            + b1_ref[...].astype(jnp.float32)
+    else:
+        h1 = jax.lax.dot(f, w1_ref[...],
+                         preferred_element_type=jnp.float32) \
+            + b1_ref[...].astype(jnp.float32)
+    a = _gelu_f32(h1)
+    if rate_hidden > 0.0 or rate_conn > 0.0:
+        r_local = (jnp.uint32(row0)
+                   + lax.broadcasted_iota(jnp.uint32, (rows, 1), 0))
+        grows = _global_rows(r_local, seeds_ref[0, 2], seeds_ref[0, 3],
+                             l_loc, l_glob)
+    if rate_hidden > 0.0:
+        a = a * _keep_rows(seeds_ref[0, 0], grows, a.shape[1],
+                           rate_hidden, seeds_ref[0, 4], dff_glob)
+    a = a.astype(h_ref.dtype)
+    if fmt is not None:
+        amax_blk_a = jnp.max(jnp.abs(a.astype(jnp.float32)))
+        f2 = qdot(a, w2_ref, scales_ref[0, 2],
+                  1.0 / (scales_ref[0, 2] * scales_ref[0, 3]))
+    else:
+        f2 = jax.lax.dot(a, w2_ref[...],
+                         preferred_element_type=jnp.float32)
+    if partial:
+        o_ref[...] = f2.astype(o_ref.dtype)
+    else:
+        f2 = f2 + b2_ref[...].astype(jnp.float32)
+        if rate_conn > 0.0:
+            f2 = f2 * _keep_rows(seeds_ref[0, 1], grows, f2.shape[1],
+                                 rate_conn)
+        o_ref[...] = (x32 + f2).astype(o_ref.dtype)
+    if fmt is not None:
+        # (1, 1) running amaxes, max-accumulated across the sequential
+        # row-block grid (every block maps the same output block)
+        af_ref, aa_ref = amax_refs
+
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            af_ref[0, 0] = amax_blk_f
+            aa_ref[0, 0] = amax_blk_a
+
+        @pl.when(pl.program_id(0) > 0)
+        def _acc():
+            af_ref[0, 0] = jnp.maximum(af_ref[0, 0], amax_blk_f)
+            aa_ref[0, 0] = jnp.maximum(aa_ref[0, 0], amax_blk_a)
+
+
+def _ffn_fwd_pallas2(h2d, ln_scale, ln_bias, w1, b1, w2, b2, seeds,
+                     scales, rate_hidden, rate_conn, eps, l_loc, l_glob,
+                     dff_glob, fmt, grad_fmt, grad_axes, partial,
+                     block_rows=256):
+    """Generalized forward dispatch: the Pallas kernel when the resident
+    set fits VMEM (weights pre-quantized to 1 byte/elem under quant),
+    the oracle otherwise (warned).  Returns (out2d, amax2) — amax2 is
+    (2,) fp32 [amax_f, amax_a], zeros when fmt is None."""
+    B, d = h2d.shape
+    d_ff = w1.shape[1]
+    d_out = w2.shape[1]
+    w_bytes = 1 if fmt is not None else jnp.dtype(w1.dtype).itemsize
+    block_rows = min(block_rows, B)
+    while (block_rows > 32
+           and _ffn_vmem_bytes(d, d_ff, w_bytes,
+                               block_rows) > _FFN_VMEM_BUDGET):
+        block_rows //= 2
+    if _ffn_vmem_bytes(d, d_ff, w_bytes, block_rows) > _FFN_VMEM_BUDGET:
+        import warnings
+        warnings.warn(
+            f"fused FFN kernel resident set for d_model={d}, d_ff={d_ff} "
+            f"exceeds the ~{_FFN_VMEM_BUDGET >> 20} MiB VMEM budget even "
+            f"at the minimum row tile; computing this sublayer with the "
+            f"XLA reference path instead (same math, default autodiff)",
+            stacklevel=2)
+        quant = (None if fmt is None else
+                 (fmt, scales[0], scales[1], scales[2], scales[3],
+                  grad_fmt, grad_axes))
+        return _ffn_body_reference(
+            h2d, ln_scale, ln_bias, w1, b1, w2, b2, seeds[0, 0],
+            seeds[0, 1], rate_hidden, rate_conn, eps, seeds[0, 2],
+            seeds[0, 3], l_loc, l_glob, seeds[0, 4], dff_glob,
+            partial, quant, return_amax=True)
+    if fmt is not None:
+        # weights quantize ONCE per call at their delayed scales — the
+        # kernel sees 1-byte operands (and the quantize sits inside the
+        # custom_vjp boundary, so the straight-through estimator in the
+        # reference backward bridges the rounding)
+        from faster_distributed_training_tpu.ops.quant import quantize
+        w1 = quantize(w1, scales[1], fmt)
+        w2 = quantize(w2, scales[3], fmt)
+    nb = -(-B // block_rows)
+    pad = nb * block_rows - B
+    if pad:
+        h2d = jnp.pad(h2d, ((0, pad), (0, 0)))
+    kern = functools.partial(_ffn_kernel2, block_rows=block_rows,
+                             rate_hidden=rate_hidden, rate_conn=rate_conn,
+                             eps=eps, l_loc=l_loc, l_glob=l_glob,
+                             dff_glob=dff_glob, fmt=fmt, partial=partial)
+    out_specs = [pl.BlockSpec((block_rows, d_out), lambda i: (i, 0))]
+    out_dtype = jnp.float32 if partial else h2d.dtype
+    out_shape = [jax.ShapeDtypeStruct((nb * block_rows, d_out), out_dtype)]
+    if fmt is not None:
+        out_specs += [pl.BlockSpec((1, 1), lambda i: (0, 0))] * 2
+        out_shape += [jax.ShapeDtypeStruct((1, 1), jnp.float32)] * 2
+    res = pl.pallas_call(
+        kern,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((d, d_ff), lambda i: (0, 0)),
+            pl.BlockSpec((1, d_ff), lambda i: (0, 0)),
+            pl.BlockSpec((d_ff, d_out), lambda i: (0, 0)),
+            pl.BlockSpec((1, d_out), lambda i: (0, 0)),
+            pl.BlockSpec((1, 5), lambda i: (0, 0)),
+            pl.BlockSpec((1, 4), lambda i: (0, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=(jax.default_backend() != "tpu"),
+    )(h2d, ln_scale.reshape(1, d), ln_bias.reshape(1, d), w1,
+      b1.reshape(1, d_ff), w2, b2.reshape(1, d_out), seeds,
+      scales.reshape(1, 4))
+    if fmt is not None:
+        out, af, aa = res
+        amax2 = jnp.stack([af[0, 0], aa[0, 0]])
+    else:
+        out = res[0] if isinstance(res, (list, tuple)) else res
+        amax2 = jnp.zeros((2,), jnp.float32)
+    return (out[:B] if pad else out), amax2
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12, 13,
+                                                    14, 15, 16, 17, 18))
+def _ffn_core2(h, ln_scale, ln_bias, w1, b1, w2, b2, seeds, scales,
+               rate_hidden: float, rate_conn: float, eps: float,
+               l_loc: int, l_glob: int, dff_glob: int, fmt,
+               grad_fmt, grad_axes, partial: bool):
+    """Generalized fused-FFN core: returns (out, amax2).  seeds (1, 5)
+    u32 [hid_seed, out_seed, b0, s0, c0]; scales (4,) fp32 [sx1, sw1,
+    sx2, sw2] (zeros when fmt is None).  partial=True emits the fp32
+    GEMM2 product (pre-b2/connection-dropout/residual) for the tp
+    psum."""
+    lead = h.shape[:-1]
+    d = h.shape[-1]
+    out2d, amax2 = _ffn_fwd_pallas2(
+        h.reshape(-1, d), ln_scale, ln_bias, w1, b1, w2, b2, seeds,
+        scales, rate_hidden, rate_conn, eps, l_loc, l_glob, dff_glob,
+        fmt, grad_fmt, grad_axes, partial)
+    return out2d.reshape(*lead, out2d.shape[-1]), amax2
+
+
+def _ffn_vjp2_fwd(h, ln_scale, ln_bias, w1, b1, w2, b2, seeds, scales,
+                  rate_hidden, rate_conn, eps, l_loc, l_glob, dff_glob,
+                  fmt, grad_fmt, grad_axes, partial):
+    out = _ffn_core2(h, ln_scale, ln_bias, w1, b1, w2, b2, seeds, scales,
+                     rate_hidden, rate_conn, eps, l_loc, l_glob, dff_glob,
+                     fmt, grad_fmt, grad_axes, partial)
+    # residuals: INPUTS only — the recompute-backward contract of
+    # _ffn_core carries over to every quant/partial combination
+    return out, (h, ln_scale, ln_bias, w1, b1, w2, b2, seeds, scales)
+
+
+def _ffn_vjp2_bwd(rate_hidden, rate_conn, eps, l_loc, l_glob, dff_glob,
+                  fmt, grad_fmt, grad_axes, partial, res, g):
+    h, ln_scale, ln_bias, w1, b1, w2, b2, seeds, scales = res
+    g_out, _g_amax = g          # the amax outputs feed state, not loss
+    quant = (None if fmt is None else
+             (fmt, scales[0], scales[1], scales[2], scales[3],
+              grad_fmt, grad_axes))
+    _, vjp = jax.vjp(
+        lambda h_, s_, bi_, w1_, b1_, w2_, b2_: _ffn_body_reference(
+            h_, s_, bi_, w1_, b1_, w2_, b2_, seeds[0, 0], seeds[0, 1],
+            rate_hidden, rate_conn, eps, seeds[0, 2], seeds[0, 3],
+            l_loc, l_glob, seeds[0, 4], dff_glob, partial, quant),
+        h, ln_scale, ln_bias, w1, b1, w2, b2)
+    zero = np.zeros(np.shape(seeds), jax.dtypes.float0)
+    return (*vjp(g_out), zero, jnp.zeros_like(scales))
+
+
+_ffn_core2.defvjp(_ffn_vjp2_fwd, _ffn_vjp2_bwd)
+
+
+def ffn_core_generalized(h, ln_scale, ln_bias, w1, b1, w2, b2,
+                         hid_seed, out_seed, b0, s0, c0,
+                         rate_hidden: float, rate_conn: float,
+                         eps: float, l_loc: int, l_glob: int,
+                         dff_glob: int = 0, quant_fmt=None,
+                         quant_scales=None, grad_fmt=None,
+                         grad_axes: tuple = (), partial: bool = False):
+    """The shard_map layer's entry to the generalized core (parallel/
+    kernel_shard.py runs this per shard; models/transformer.py calls it
+    directly for the unsharded quantized composition).  Returns
+    (out, amax2) with amax2 = (2,) fp32 [amax_f, amax_a] current-step
+    amaxes (zeros when quant_fmt is None).  b0/s0/c0: global batch-row
+    / sequence / d_ff-column offsets of this shard; quant_scales:
+    (sx1, sw1, sx2, sw2) traced scalars when quant_fmt is set."""
+    seeds = jnp.stack([jnp.asarray(hid_seed, jnp.uint32),
+                       jnp.asarray(out_seed, jnp.uint32),
+                       jnp.asarray(b0, jnp.uint32),
+                       jnp.asarray(s0, jnp.uint32),
+                       jnp.asarray(c0, jnp.uint32)]).reshape(1, 5)
+    scales = pack_scales(quant_scales if quant_fmt is not None else None)
+    return _ffn_core2(h, ln_scale, ln_bias, w1, b1, w2, b2, seeds,
+                      scales, float(rate_hidden), float(rate_conn),
+                      float(eps), int(l_loc), int(l_glob),
+                      int(dff_glob) if dff_glob else int(w1.shape[1]),
+                      quant_fmt, grad_fmt, tuple(grad_axes),
+                      bool(partial))
+
+

@@ -28,19 +28,23 @@ training recipe:
     backward — the gradient GEMMs themselves run in the compute dtype
     (straight-through estimator through the rounding), so training
     dynamics stay close to the full-precision path while forward GEMMs
-    and residual memory take the low-precision win.  fp8-E5M2 gradient
-    quantization is provided as a helper but not yet wired (see the
-    README mode matrix caveat).
+    and residual memory take the low-precision win.  ``--quant_grad
+    fp8_e5m2`` (r19) completes the FP8-LM recipe: the cotangent is
+    quantized to the wide-range E5M2 grid at a just-in-time per-tensor
+    scale and BOTH gradient GEMMs run on quantized operands (the
+    quantized-dW path).
 
 Kernel routing follows the repo's Pallas idioms (ops/fused_ffn.py):
 the tiled Pallas kernel runs only on TPU, respects a static VMEM-fit
 guard (``quant_kernel_fits_vmem``) with a degrading row tile, and falls
 back WARNED to the XLA reference path — same math, ``lax.dot_general``
-on the quantized operands — on unsupported shapes.  tp meshes never
-see the kernel at all (Pallas custom calls don't partition over tp;
-cli.build_model routes them to the XLA reference path, the r11
-capability-fallback idiom).  ``FDT_QUANT=0`` kills quantization
-entirely — every site computes the plain full-precision matmul.
+on the quantized operands — on unsupported shapes.  On tp meshes the
+kernel runs PER-SHARD on the Megatron column/row-sharded weight tiles
+through the shard_map layer (parallel/kernel_shard.py, r19); the old
+XLA-reference reroute survives only as the registered warned fallback
+(FDT_KERNEL_SHARD=0 or non-dividing shapes).  ``FDT_QUANT=0`` kills
+quantization entirely — every site computes the plain full-precision
+matmul.
 
 Determinism contract: quantization is round-to-nearest (no stochastic
 rounding), amaxes are plain max-reductions, and the scale state rides
@@ -110,8 +114,15 @@ def scale_from_history(history: jax.Array, fmt: str,
     (fresh state, or a genuinely all-zero tensor) yields scale 1.0 —
     quantizing zeros is exact at any scale, and the first real step
     seeds the history for the second."""
+    return _scale_from_amax(jnp.max(history) * jnp.float32(margin), fmt)
+
+
+def _scale_from_amax(amax: jax.Array, fmt: str) -> jax.Array:
+    """THE amax→scale formula (zero-amax → identity scale, 1e-30
+    floor): shared by the delayed forward scales (scale_from_history)
+    and the just-in-time gradient scales (_jit_grad_scale) so the two
+    recipes can never drift on the clamp/zero-guard convention."""
     qmax = QMAX[fmt]
-    amax = jnp.max(history) * jnp.float32(margin)
     return jnp.where(amax > 0.0, qmax / jnp.maximum(amax, 1e-30),
                      jnp.float32(1.0)).astype(jnp.float32)
 
@@ -281,9 +292,41 @@ def quant_dot_pallas(xq: jax.Array, wq: jax.Array, sx: jax.Array,
 # and runs the two gradient GEMMs in the cotangent's dtype — the
 # straight-through estimator through the rounding, so d/dx passes
 # through quantize∘dequantize as identity (at the dequantized values).
+# grad_fmt="fp8_e5m2" (r19, the FP8-LM completion) additionally
+# quantizes the incoming COTANGENT to the wide-range E5M2 grid with
+# just-in-time per-tensor scaling and runs BOTH gradient GEMMs on
+# quantized operands — dW contracts the saved xq against gq directly
+# (the quantized-dW path), dx contracts gq against the saved wq.
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _quant_dot_core(x, w, sx, sw, fmt: str, use_pallas: bool):
+_GRAD_FMTS = (None, "fp8_e5m2")
+
+
+def _jit_grad_scale(amax: jax.Array, fmt: str) -> jax.Array:
+    """Just-in-time (current-tensor) scale for gradient quantization:
+    gradients exist only inside the backward, where no carried history
+    can be updated — so their scale comes from THIS tensor's amax (the
+    deterministic "current scaling" variant of the delayed recipe; the
+    forward operands keep their delayed history scales).  Same
+    amax→scale formula as the forward (_scale_from_amax)."""
+    return _scale_from_amax(amax, fmt)
+
+
+def _dot_q_mixed(a: jax.Array, b: jax.Array, dims) -> jax.Array:
+    """Quantized-operand contraction with arbitrary dims, fp32 result.
+    int8 x int8 pairs take the exact s8xs8->s32 path; any fp8 operand
+    (every fp8/int8 value is exactly representable in fp32) upcasts."""
+    if a.dtype == jnp.int8 and b.dtype == jnp.int8:
+        return lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.int32
+                               ).astype(jnp.float32)
+    return lax.dot_general(a.astype(jnp.float32), b.astype(jnp.float32),
+                           (dims, ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _quant_dot_core(x, w, sx, sw, fmt: str, use_pallas: bool,
+                    grad_fmt: Optional[str], grad_axes: tuple):
     xq = quantize(x, sx, fmt)
     wq = quantize(w, sw, fmt)
     if use_pallas:
@@ -291,7 +334,7 @@ def _quant_dot_core(x, w, sx, sw, fmt: str, use_pallas: bool):
     return quant_dot_reference(xq, wq, sx, sw, fmt, x.dtype)
 
 
-def _quant_dot_fwd(x, w, sx, sw, fmt, use_pallas):
+def _quant_dot_fwd(x, w, sx, sw, fmt, use_pallas, grad_fmt, grad_axes):
     # quantize ONCE: the same arrays feed the GEMM and become the
     # residuals (1 byte/elem instead of 2/4, the quantized-training
     # residual-memory win) — no reliance on CSE to dedupe a second
@@ -302,13 +345,32 @@ def _quant_dot_fwd(x, w, sx, sw, fmt, use_pallas):
     return dot(xq, wq, sx, sw, fmt, x.dtype), (xq, wq, sx, sw)
 
 
-def _quant_dot_bwd(fmt, use_pallas, res, g):
+def _quant_dot_bwd(fmt, use_pallas, grad_fmt, grad_axes, res, g):
     xq, wq, sx, sw = res
+    if grad_fmt is not None:
+        # fp8-E5M2 gradient quantization + quantized dW/dx path: the
+        # cotangent rides the wide-range grid (E5M2 keeps inf/nan and
+        # tops at 57344 — the variant the fp8 literature reserves for
+        # gradients) at a just-in-time per-tensor scale, and both
+        # gradient GEMMs contract quantized operands with fp32
+        # accumulation.  grad_axes: mesh axes this op runs sharded over
+        # (parallel/kernel_shard.py) — the amax is pmax'd over them so
+        # the per-TENSOR scale stays placement-invariant.
+        amax_g = tensor_amax(g)
+        for ax in grad_axes:
+            amax_g = lax.pmax(amax_g, ax)
+        sg = _jit_grad_scale(amax_g, grad_fmt)
+        gq = quantize(g, sg, grad_fmt)
+        dx = (_dot_q_mixed(gq, wq, ((1,), (1,)))
+              * (1.0 / (sg * sw.astype(jnp.float32)))).astype(g.dtype)
+        dw = (_dot_q_mixed(xq, gq, ((0,), (0,)))
+              * (1.0 / (sx.astype(jnp.float32) * sg))).astype(g.dtype)
+        return dx, dw, jnp.zeros_like(sx), jnp.zeros_like(sw)
     x_deq = dequantize(xq, sx, g.dtype)
     w_deq = dequantize(wq, sw, g.dtype)
     # gradient GEMMs in the compute dtype with fp32 accumulation (the
-    # "fwd quantized / bwd high precision" recipe; E5M2 grad
-    # quantization is a documented future step, not wired)
+    # "fwd quantized / bwd high precision" recipe; --quant_grad
+    # fp8_e5m2 selects the quantized-gradient branch above)
     dx = lax.dot_general(g, w_deq, (((1,), (1,)), ((), ())),
                          preferred_element_type=jnp.float32
                          ).astype(x_deq.dtype)
@@ -323,21 +385,30 @@ _quant_dot_core.defvjp(_quant_dot_fwd, _quant_dot_bwd)
 
 
 def quant_dot(x: jax.Array, w: jax.Array, sx: jax.Array, sw: jax.Array,
-              fmt: str, use_pallas: Optional[bool] = None) -> jax.Array:
+              fmt: str, use_pallas: Optional[bool] = None,
+              grad_fmt: Optional[str] = None,
+              grad_axes: tuple = ()) -> jax.Array:
     """out[m,n] = dequant(quant(x) · quant(w)) with fp32/int32
     accumulation.  x: (M, K); w: (K, N); sx/sw: fp32 scalar DELAYED
     scales (ops.quant.scale_from_history).  use_pallas None = auto
-    (TPU and the shape fits VMEM); the caller may force False (tp-mesh
-    routing, cli.build_model)."""
+    (TPU and the shape fits VMEM); the caller may force False (the
+    registered warned fallbacks, cli.build_model) — tp meshes route the
+    kernel per-shard through parallel/kernel_shard.py instead.
+    grad_fmt "fp8_e5m2" quantizes the backward's cotangent (JIT-scaled)
+    and contracts the gradient GEMMs on quantized operands; grad_axes
+    names the mesh axes a sharded caller runs under (amax pmax)."""
     if fmt not in _FMTS:
         raise ValueError(f"quant_dot fmt must be one of {_FMTS}, "
                          f"got {fmt!r}")
+    if grad_fmt not in _GRAD_FMTS:
+        raise ValueError(f"quant_dot grad_fmt must be one of "
+                         f"{_GRAD_FMTS}, got {grad_fmt!r}")
     if use_pallas is None:
         use_pallas = (jax.default_backend() == "tpu"
                       and quant_kernel_fits_vmem(x.shape[-1], w.shape[-1]))
     return _quant_dot_core(x, w, jnp.asarray(sx, jnp.float32),
                            jnp.asarray(sw, jnp.float32), fmt,
-                           bool(use_pallas))
+                           bool(use_pallas), grad_fmt, tuple(grad_axes))
 
 
 # -- flax site modules ----------------------------------------------------
@@ -380,10 +451,20 @@ try:
         fmt: str = "int8"
         amax_history_len: int = 16
         margin: float = 1.0
-        use_pallas: Optional[bool] = None   # None = auto; False = tp route
+        use_pallas: Optional[bool] = None   # None = auto; False = the
+                                            # registered warned fallback
         frozen_scales: bool = False         # True = inference: restored
                                             # amax history used, never
                                             # rolled (serve/engine.py)
+        mesh: Optional[object] = None       # tp mesh: the GEMM runs
+                                            # per-shard via the r19
+                                            # shard_map kernel layer
+        tp_dim: Optional[int] = None        # kernel dim sharded on tp
+                                            # (0 = Megatron row-parallel,
+                                            # >0 = column-parallel); None
+                                            # = never shard this site
+        grad_fmt: Optional[str] = None      # "fp8_e5m2": quantized
+                                            # gradients + dW (quant_dot)
         kernel_init: object = nn.initializers.lecun_normal()
         bias_init: object = nn.initializers.zeros
         dtype: object = jnp.float32
@@ -431,8 +512,32 @@ try:
                             hist_x.value, tensor_amax(x2d))
                         hist_w.value = update_amax_history(
                             hist_w.value, tensor_amax(w2d))
-                out = quant_dot(x2d, w2d, sx, sw, self.fmt,
-                                self.use_pallas).astype(jnp.float32)
+                from faster_distributed_training_tpu.parallel import (
+                    kernel_shard)
+                if kernel_shard.quant_tp_routed(self.mesh, self.tp_dim,
+                                                np.shape(kernel),
+                                                self.use_pallas):
+                    # r19 shard_map layer: the quant GEMM runs per-shard
+                    # on the Megatron column/row tile this site's TP
+                    # rule implies — the Pallas kernel partitions over
+                    # tp instead of falling back to the XLA reference
+                    out = kernel_shard.quant_dense_sharded(
+                        x2d, kernel.astype(self.dtype), sx, sw, self.fmt,
+                        self.mesh, self.tp_dim, grad_fmt=self.grad_fmt
+                    ).astype(jnp.float32)
+                else:
+                    # the registered warned fallback: a tp mesh whose
+                    # site can't route through the shard_map layer
+                    # (kill switch / non-dividing shape / no tp_dim)
+                    # must never hand a logically-global array to the
+                    # Pallas kernel — the XLA reference dot partitions
+                    # like any other dot
+                    from faster_distributed_training_tpu.parallel.mesh \
+                        import tp_size as _tp
+                    up = False if _tp(self.mesh) > 1 else self.use_pallas
+                    out = quant_dot(x2d, w2d, sx, sw, self.fmt,
+                                    up, grad_fmt=self.grad_fmt
+                                    ).astype(jnp.float32)
             out = out + bias.astype(jnp.float32).reshape(1, n_out)
             return out.astype(self.dtype).reshape(*lead, *feats)
 

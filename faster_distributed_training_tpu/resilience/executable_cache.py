@@ -75,6 +75,20 @@ from faster_distributed_training_tpu.resilience import storage as storage_mod
 
 ENV_CACHE = "FDT_EXEC_CACHE"
 
+# retention GC bounds (r19 satellite; r17 caveat "no retention GC
+# yet"): the _exec_cache/ prefix is bounded by entry count AND total
+# payload bytes with LRU eviction by last_used — a long-lived
+# checkpoint_dir no longer accretes one executable per (HLO x
+# environment) key forever.  Env overrides for bench/tests.
+ENV_MAX_ENTRIES = "FDT_EXEC_CACHE_MAX_ENTRIES"
+ENV_MAX_BYTES = "FDT_EXEC_CACHE_MAX_BYTES"
+DEFAULT_MAX_ENTRIES = 64
+DEFAULT_MAX_BYTES = 512 * 1024 * 1024
+
+# sidecar suffix recording an entry's last USE (hits don't rewrite the
+# payload — a zero-byte touch file's mtime is the LRU clock instead)
+_USED_SUFFIX = ".last_used"
+
 # frame: magic + 8-byte big-endian payload length + payload.  Anything
 # that fails the frame check is treated as corrupt and recompiled.
 _MAGIC = b"FDTXEC01"
@@ -158,16 +172,24 @@ class ExecutableCache:
     def __init__(self, directory: str,
                  backend: Optional[storage_mod.StorageBackend] = None,
                  mesh=None, donate: Optional[bool] = None,
-                 log: Callable[[str], None] = print):
+                 log: Callable[[str], None] = print,
+                 max_entries: Optional[int] = None,
+                 max_bytes: Optional[int] = None):
         self.directory = os.path.abspath(directory)
         self.backend = backend if backend is not None \
             else storage_mod.posix_backend()
         self.env_key = environment_key(mesh=mesh, donate=donate)
         self._log = log
         self._warned: set = set()
+        self.max_entries = int(
+            os.environ.get(ENV_MAX_ENTRIES, "") or
+            (DEFAULT_MAX_ENTRIES if max_entries is None else max_entries))
+        self.max_bytes = int(
+            os.environ.get(ENV_MAX_BYTES, "") or
+            (DEFAULT_MAX_BYTES if max_bytes is None else max_bytes))
         self.stats: Dict[str, int] = {
             "hits": 0, "misses": 0, "stores": 0, "corrupt": 0,
-            "store_failures": 0, "skipped_served": 0}
+            "store_failures": 0, "skipped_served": 0, "evicted": 0}
         self.backend.ensure_dir(self.directory)
 
     # -- keys --------------------------------------------------------------
@@ -211,6 +233,7 @@ class ExecutableCache:
                 f"cache entry never blocks recovery)")
             return None
         self.stats["hits"] += 1
+        self._touch(key)
         return compiled
 
     def store(self, key: str, compiled) -> bool:
@@ -230,7 +253,90 @@ class ExecutableCache:
                 f"on the next restart")
             return False
         self.stats["stores"] += 1
+        self.gc()
         return True
+
+    # -- retention GC ------------------------------------------------------
+
+    def _touch(self, key: str) -> None:
+        """Best-effort LRU clock tick: a hit refreshes the entry's
+        ``.last_used`` sidecar mtime instead of rewriting the payload."""
+        try:
+            self.backend.put_bytes(key + _USED_SUFFIX, b"")
+        except Exception:
+            pass
+
+    def _last_used(self, key: str) -> float:
+        """last_used for LRU ordering: the sidecar's mtime when present
+        (a hit touched it), else the entry's own (its store time)."""
+        try:
+            if self.backend.exists(key + _USED_SUFFIX):
+                return self.backend.mtime(key + _USED_SUFFIX)
+        except Exception:
+            pass
+        try:
+            return self.backend.mtime(key)
+        except Exception:
+            return 0.0
+
+    def entries(self):
+        """[(key, bytes, last_used)] for every cache entry under the
+        directory (sidecars excluded)."""
+        out = []
+        try:
+            keys = self.backend.list_prefix(
+                self.backend.join(self.directory, "exec_"))
+        except Exception:
+            return out
+        for k in keys:
+            if k.endswith(_USED_SUFFIX):
+                continue
+            try:
+                out.append((k, self.backend.size(k), self._last_used(k)))
+            except Exception:
+                continue
+        return out
+
+    def gc(self) -> int:
+        """Retention GC (r19 satellite): keep the most-recently-used
+        entries while count <= max_entries and total bytes <= max_bytes;
+        evict the LRU tail (entry + sidecar).  Best-effort like every
+        other method — a GC failure must never block the compile path.
+        Returns the number of entries evicted."""
+        ents = self.entries()
+        if not ents:
+            return 0
+        ents.sort(key=lambda e: e[2], reverse=True)   # newest first
+        evicted = 0
+        kept = total = 0
+        for key, nbytes, _ in ents:
+            kept += 1
+            total += nbytes
+            # the MRU entry always survives, even past the byte bound:
+            # evicting a single over-budget executable right after its
+            # own store would permanently disable the cache for that
+            # program (every restart recompiling while stats show
+            # stores and evictions balancing)
+            if kept == 1 or (kept <= self.max_entries
+                             and total <= self.max_bytes):
+                continue
+            try:
+                self.backend.delete(key)
+                try:
+                    self.backend.delete(key + _USED_SUFFIX)
+                except Exception:
+                    pass
+                evicted += 1
+            except Exception:
+                continue
+        if evicted:
+            self.stats["evicted"] += evicted
+            self._warn_once(
+                "gc", f"[exec_cache] retention GC evicted {evicted} LRU "
+                f"entr{'y' if evicted == 1 else 'ies'} (bounds: "
+                f"{self.max_entries} entries / {self.max_bytes >> 20} "
+                f"MiB; {ENV_MAX_ENTRIES}/{ENV_MAX_BYTES} override)")
+        return evicted
 
     def note_skipped_served(self) -> None:
         """The observatory declined to store an executable because the
@@ -287,6 +393,7 @@ def build_executable_cache(cfg, backend=None, mesh=None,
     cache = ExecutableCache(directory, backend=backend, mesh=mesh,
                             donate=bool(getattr(cfg, "donate", True)),
                             log=log)
+    cache.gc()    # a long-lived prefix shrinks to bounds at arm time
     log(f"[exec_cache] persistent executable cache armed at {directory} "
         f"(env key {cache.env_key}; a restarted process deserializes "
         f"its programs instead of recompiling)")

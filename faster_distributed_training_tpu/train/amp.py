@@ -46,18 +46,25 @@ class QuantPolicy(NamedTuple):
     margin: extra headroom multiplier on the running amax.
     use_pallas: None = auto (Pallas kernel on TPU when the shape fits
       the VMEM budget); False = force the XLA reference path — the
-      tp-mesh capability fallback sets this (Pallas custom calls don't
-      partition over tp, the r11 flash precedent).
+      REGISTERED warned fallback cli.build_model sets when the r19
+      shard_map kernel layer can't serve a tp mesh (FDT_KERNEL_SHARD=0
+      or non-dividing shapes); serviceable tp meshes keep None and the
+      kernel runs per-shard (parallel/kernel_shard.py).
     frozen_scales: inference mode (serve/): quantize at the scales the
       RESTORED amax history implies and never roll it — serving is
       state-free and bitwise-reproducible per request
       (cli.build_model(serving=True) sets it; training must keep
-      False — delayed scaling needs the roll)."""
+      False — delayed scaling needs the roll).
+    grad_fmt: None, or "fp8_e5m2" (--quant_grad): quantize the
+      backward's cotangents to the wide-range E5M2 grid at a
+      just-in-time per-tensor scale and run BOTH gradient GEMMs on
+      quantized operands — the FP8-LM completion (ops/quant.py)."""
     fmt: str
     amax_history_len: int = 16
     margin: float = 1.0
     use_pallas: Optional[bool] = None
     frozen_scales: bool = False
+    grad_fmt: Optional[str] = None
 
 
 def resolve_quant_policy(cfg) -> Optional["QuantPolicy"]:
@@ -65,11 +72,24 @@ def resolve_quant_policy(cfg) -> Optional["QuantPolicy"]:
     routing (use_pallas) is layered on by cli.build_model, which knows
     the mesh."""
     mode = (getattr(cfg, "quant", "none") or "none").lower()
+    grad = (getattr(cfg, "quant_grad", "none") or "none").lower()
     if mode in ("", "none"):
+        if grad not in ("", "none"):
+            import warnings
+            warnings.warn(
+                f"--quant_grad {grad} requires --quant int8/fp8 (gradient "
+                f"quantization rides the quantized GEMM sites); running "
+                f"full-precision", stacklevel=2)
         return None
     if mode not in ("int8", "fp8"):
         raise ValueError(f"--quant must be none/int8/fp8, got {mode!r}")
-    return QuantPolicy(fmt=mode)
+    if grad in ("", "none"):
+        grad_fmt = None
+    elif grad in ("fp8_e5m2", "e5m2"):
+        grad_fmt = "fp8_e5m2"
+    else:
+        raise ValueError(f"--quant_grad must be none/fp8_e5m2, got {grad!r}")
+    return QuantPolicy(fmt=mode, grad_fmt=grad_fmt)
 
 
 class LossScaleState(NamedTuple):

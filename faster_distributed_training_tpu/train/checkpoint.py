@@ -174,12 +174,20 @@ def restore_checkpoint(checkpoint_dir: str, name: str, state: TrainState
             restored = ckptr.restore(
                 path, args=ocp.args.StandardRestore(template))
     except Exception as structural:
-        # Possibly a pre-round-3 checkpoint (flat attn_{i}/query|key|value
-        # layout): raw-restore, remap the param tree, and re-validate.
+        # Possibly an UNTIED-lm-head checkpoint (r18 layout) restoring
+        # into a tied model (r19 default: no lm_head param): drop the
+        # separate projection, warned.  Else possibly a pre-round-3
+        # checkpoint (flat attn_{i}/query|key|value layout):
+        # raw-restore, remap the param tree, and re-validate.
         # Optimizer state mirrors the param structure and cannot be
         # meaningfully folded (Fisher factors/momenta were tracked per
-        # UNFUSED kernel), so it restarts fresh — loudly.
-        restored = _restore_legacy(path, template, structural)
+        # UNFUSED kernel), so it restarts fresh — loudly.  The raw
+        # restore runs ONCE; both shims consume the same tree.
+        raw = _raw_restore_any(path)
+        restored = _restore_untied_lm_head(path, template, raw=raw)
+        if restored is None:
+            restored = _restore_legacy(path, template, structural,
+                                       raw=raw)
     meta = read_checkpoint_meta(checkpoint_dir, name)
     epoch = int(meta.get("epoch", 0))
     best_acc = float(meta.get("best_acc", 0.0))
@@ -204,26 +212,34 @@ def _raw_restore_numpy(path: str) -> Any:
     return ckptr.restore(path, args=ocp.args.PyTreeRestore(restore_args=ra))
 
 
-def _restore_legacy(path: str, template: Any, structural: Exception) -> Any:
-    """Raw-restore a structurally mismatched checkpoint, migrate the
-    legacy transformer param layout, and fit it onto `template`.  Leaves
-    that still don't line up re-raise the original error."""
-    # Genuine old checkpoints carry the DEVICE SHARDINGS of the machine
-    # that wrote them (e.g. a TPU that isn't attached at restore time),
-    # so the raw restore must be type-erased to numpy via metadata-driven
-    # RestoreArgs — proven against the committed round-2 fixture
-    # (tests/fixtures/legacy_transformer, saved on a TPU v5e).  The
-    # plain StandardCheckpointer/PyTreeCheckpointer raw restores remain
-    # as fallbacks for same-topology layouts.
-    raw = None
+def _raw_restore_any(path: str) -> Optional[Any]:
+    """The shared raw-restore chain of the compat shims: type-erased
+    numpy first (old checkpoints carry the writing machine's device
+    shardings), plain restores as same-topology fallbacks.  None when
+    every attempt fails (corrupt checkpoint).  Called ONCE per
+    structural mismatch — both shims consume the same tree instead of
+    re-reading a multi-GB checkpoint from storage twice."""
     for restore in (_raw_restore_numpy,
                     lambda p: ocp.StandardCheckpointer().restore(p),
                     lambda p: ocp.PyTreeCheckpointer().restore(p)):
         try:
-            raw = restore(path)
-            break
+            return restore(path)
         except Exception:
             continue
+    return None
+
+
+def _restore_legacy(path: str, template: Any, structural: Exception,
+                    raw: Any = None) -> Any:
+    """Raw-restore a structurally mismatched checkpoint, migrate the
+    legacy transformer param layout, and fit it onto `template`.  Leaves
+    that still don't line up re-raise the original error."""
+    # Raw-restore semantics documented on _raw_restore_any (proven
+    # against the committed round-2 fixture tests/fixtures/
+    # legacy_transformer, saved on a TPU v5e); restore_checkpoint
+    # passes the already-read tree in so the chain runs once.
+    if raw is None:
+        raw = _raw_restore_any(path)
     if raw is None:
         raise structural       # corrupt checkpoint: surface the ORIGINAL error
     params = raw.get("params") if isinstance(raw, dict) else None
@@ -274,6 +290,65 @@ def _restore_legacy(path: str, template: Any, structural: Exception) -> Any:
             "opt_state": template["opt_state"],
             "loss_scale": template["loss_scale"],
             "rng": template["rng"]}
+
+
+def _drop_lm_head(tree: Any) -> Any:
+    """The tree minus every ``lm_head`` dict subtree (any depth) — the
+    untied→tied compat prune.  lm_head only ever appears as a dict key
+    (flax module name), so list/tuple indices never shift."""
+    if isinstance(tree, dict):
+        return {k: _drop_lm_head(v) for k, v in tree.items()
+                if k != "lm_head"}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_drop_lm_head(v) for v in tree)
+    return tree
+
+
+def _restore_untied_lm_head(path: str, template: Any,
+                            raw: Any = None) -> Optional[Any]:
+    """Compat shim for the r19 tied LM head (ROADMAP r18 follow-on (c)):
+    an UNTIED checkpoint (separate ``lm_head`` projection) restores into
+    a tied template by DROPPING the projection everywhere it appears —
+    params, optimizer state, batch_stats — with a warning; the tied
+    model serves logits from token_embedding^T instead.  Returns None
+    when the mismatch is not this case (caller falls through to the
+    legacy shim / the original structural error)."""
+    if raw is None:
+        raw = _raw_restore_any(path)
+    if raw is None or not isinstance(raw, dict):
+        return None
+    params = raw.get("params")
+    try:
+        raw_model = params["model"]
+        tmpl_model = template["params"]["model"]
+    except (KeyError, TypeError):
+        return None
+    if not (isinstance(raw_model, dict) and "lm_head" in raw_model
+            and isinstance(tmpl_model, dict)
+            and "lm_head" not in tmpl_model):
+        return None
+    try:
+        rebuilt = _fit_leaves(_drop_lm_head(params), template["params"],
+                              "params")
+    except ValueError:
+        return None
+    warnings.warn(
+        "restored an untied-lm-head checkpoint into a tied model "
+        "(tie_lm_head=True, the r19 default): the separate lm_head "
+        "projection and its optimizer state are DROPPED — logits now "
+        "come from token_embedding^T, so the restored model's head "
+        "re-converges from the embedding table.  Pass --untie_lm_head "
+        "to restore the r18 head exactly.", stacklevel=4)
+    return {"step": raw.get("step", template["step"]),
+            "params": rebuilt,
+            "batch_stats": _fit_or_template(
+                _drop_lm_head(raw.get("batch_stats")),
+                template["batch_stats"], "batch_stats"),
+            "opt_state": _fit_or_template(
+                _drop_lm_head(raw.get("opt_state")),
+                template["opt_state"], "opt_state"),
+            "loss_scale": raw.get("loss_scale", template["loss_scale"]),
+            "rng": raw.get("rng", template["rng"])}
 
 
 def _fit_leaves(raw_sub: Any, template_sub: Any, label: str) -> Any:

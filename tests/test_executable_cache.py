@@ -67,7 +67,7 @@ class TestExecutableCache:
         assert e2["cache_method"] == "executable_cache"
         assert obs2.executable_cache.stats == {
             "hits": 1, "misses": 0, "stores": 0, "corrupt": 0,
-            "store_failures": 0, "skipped_served": 0}
+            "store_failures": 0, "skipped_served": 0, "evicted": 0}
         # the same executable: outputs are bitwise-identical
         np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
         # same HLO -> same key, same fingerprint across the "processes"
@@ -318,3 +318,120 @@ def test_storage_routing_lint_covers_executable_cache():
             f.write("import os\n\ndef bad(a, b):\n    os.replace(a, b)\n")
         hits = lint._banned_calls(scratch)
         assert hits and hits[0][1] == "os.replace"
+
+
+class TestRetentionGC:
+    """r19 satellite (r17 caveat "no retention GC yet"): the
+    ``_exec_cache/`` prefix is bounded by entry count AND total payload
+    bytes with LRU eviction by ``last_used`` — TPU executables are
+    multi-MB per program, so a long-lived checkpoint_dir must not
+    accrete one entry per (HLO x environment) key forever."""
+
+    @staticmethod
+    def _cache(tmp, **kw):
+        return ec.ExecutableCache(str(tmp), log=lambda *_: None, **kw)
+
+    @staticmethod
+    def _put(cache, name, payload=b"x" * 64, when=None):
+        key = cache.key_for(name, "fp_" + name)
+        cache.backend.put_bytes(key, ec._MAGIC
+                                + len(payload).to_bytes(8, "big") + payload)
+        if when is not None:
+            os.utime(key, (when, when))
+        return key
+
+    def test_entry_count_bound_evicts_lru(self, tmp_path):
+        cache = self._cache(tmp_path, max_entries=3,
+                            max_bytes=1 << 30)
+        t0 = 1_700_000_000.0
+        keys = [self._put(cache, f"p{i}", when=t0 + i) for i in range(5)]
+        assert cache.gc() == 2
+        assert cache.stats["evicted"] == 2
+        # the two OLDEST (p0, p1) are gone; the newest three remain
+        for k in keys[:2]:
+            assert not cache.backend.exists(k)
+        for k in keys[2:]:
+            assert cache.backend.exists(k)
+
+    def test_byte_bound_evicts_lru(self, tmp_path):
+        entry = b"y" * 1024
+        frame = len(ec._MAGIC) + 8 + len(entry)
+        cache = self._cache(tmp_path, max_entries=100,
+                            max_bytes=2 * frame)
+        t0 = 1_700_000_000.0
+        keys = [self._put(cache, f"p{i}", payload=entry, when=t0 + i)
+                for i in range(4)]
+        assert cache.gc() == 2
+        assert [cache.backend.exists(k) for k in keys] == [
+            False, False, True, True]
+        # total bytes now within the bound
+        assert sum(b for _, b, _ in cache.entries()) <= 2 * frame
+
+    def test_hit_touch_refreshes_lru_order(self, tmp_path):
+        cache = self._cache(tmp_path, max_entries=2, max_bytes=1 << 30)
+        t0 = 1_700_000_000.0
+        k_old = self._put(cache, "old", when=t0)
+        k_mid = self._put(cache, "mid", when=t0 + 10)
+        k_new = self._put(cache, "new", when=t0 + 20)
+        # a HIT on the oldest entry touches its .last_used sidecar,
+        # moving it to the FRONT of the LRU order — the mid entry is
+        # now the tail and gets evicted instead
+        cache._touch(k_old)
+        assert cache.gc() == 1
+        assert cache.backend.exists(k_old)
+        assert not cache.backend.exists(k_mid)
+        assert cache.backend.exists(k_new)
+        # the evicted entry's sidecar would be gone too (none written
+        # here); the survivor's sidecar remains
+        assert cache.backend.exists(k_old + ec._USED_SUFFIX)
+
+    def test_store_path_triggers_gc_and_never_blocks(self, tmp_path):
+        """The live path: stores beyond the bound evict through the
+        same best-effort GC (and a fresh arm GCs a long-lived dir)."""
+        os.environ["FDT_EXEC_CACHE_MAX_ENTRIES"] = "2"
+        try:
+            x = jnp.ones((16, 16))
+            obs, w = _observe(str(tmp_path))
+            assert obs.executable_cache.max_entries == 2
+            w(x)
+            assert obs.executable_cache.stats["stores"] == 1
+            # seed two stale artificial entries older than the real one
+            t0 = 1_600_000_000.0
+            ca = obs.executable_cache
+            ka = ca.key_for("stale_a", "fp_a")
+            kb = ca.key_for("stale_b", "fp_b")
+            for i, k in enumerate((ka, kb)):
+                ca.backend.put_bytes(k, ec._MAGIC + (8).to_bytes(8, "big")
+                                     + b"z" * 8)
+                os.utime(k, (t0 + i, t0 + i))
+            assert ca.gc() == 1                  # bound 2: oldest goes
+            assert not ca.backend.exists(ka)
+            # a SECOND "process" arming the same dir still deserializes
+            # its program (the real entry survived as most-recent)
+            obs2, w2 = _observe(str(tmp_path))
+            w2(x)
+            assert obs2.programs["train:t:k1"][0]["cache_source"] == \
+                "deserialized"
+        finally:
+            os.environ.pop("FDT_EXEC_CACHE_MAX_ENTRIES", None)
+
+    def test_build_gc_on_arm(self, tmp_path):
+        """build_executable_cache shrinks an over-bound dir at arm time
+        (the long-lived checkpoint_dir case)."""
+        cache = self._cache(os.path.join(str(tmp_path), "_exec_cache"))
+        t0 = 1_700_000_000.0
+        for i in range(6):
+            self._put(cache, f"p{i}", when=t0 + i)
+
+        class _Cfg:
+            executable_cache = "on"     # -> <checkpoint_dir>/_exec_cache
+            checkpoint_dir = str(tmp_path)
+            donate = False
+
+        os.environ["FDT_EXEC_CACHE_MAX_ENTRIES"] = "4"
+        try:
+            armed = ec.build_executable_cache(_Cfg(), log=lambda *_: None)
+        finally:
+            os.environ.pop("FDT_EXEC_CACHE_MAX_ENTRIES", None)
+        assert armed is not None
+        assert len(armed.entries()) == 4

@@ -1,0 +1,604 @@
+"""r19 tentpole tests: parallel/kernel_shard.py — ONE shard_map layer
+that runs every Pallas kernel per-shard on tp meshes, closing the
+thrice-recorded capability gap (flash r11, fused-FFN r11, quant-matmul
+r13: Pallas custom calls don't partition over tp).
+
+The ISSUE acceptance pins, all tier-1 on the 8-virtual-device CPU mesh
+(conftest) with clean `requires_devices` degradation elsewhere:
+
+  * on a simulated dp=2,tp=2 mesh, `build_model` emits ZERO
+    capability-fallback warnings for --attention flash, --ffn_impl
+    pallas, and --quant {int8,fp8} when shapes divide tp;
+  * each recovered kernel matches its XLA/flax fallback within the
+    existing tolerance pins: head-sharded flash vs the unsharded
+    kernel, Megatron column/row fused-FFN (ONE psum) vs the unsharded
+    sublayer, per-site quant GEMM tiles vs the full-array quant_dot —
+    forward AND gradients, dropout masks placement-invariant;
+  * K=4 fused dispatch twins K=1 with the sharded kernels on;
+  * scripts/check_kernel_routing.py (the AST lint that makes a FOURTH
+    silent tp gap a tier-1 failure) is wired here and clean.
+"""
+
+import importlib.util
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from faster_distributed_training_tpu.config import TrainConfig
+from faster_distributed_training_tpu.ops import quant as Q
+from faster_distributed_training_tpu.parallel import kernel_shard, make_mesh
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tree_allclose(a, b, rtol, atol=0.0):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=rtol, atol=atol)
+
+
+# -------------------------------------------------------------------------
+# serviceability predicates + kill switch
+# -------------------------------------------------------------------------
+
+class TestServiceability:
+    def test_flash_serviceable(self, requires_devices, devices8,
+                               monkeypatch):
+        requires_devices(8)
+        mesh = make_mesh(("dp", "tp"), (4, 2), devices8)
+        assert kernel_shard.flash_serviceable(mesh, 8)
+        assert not kernel_shard.flash_serviceable(mesh, 3)  # 3 % 2
+        assert not kernel_shard.flash_serviceable(None, 8)  # no mesh
+        m1 = make_mesh(("dp",), (8,), devices8)
+        assert not kernel_shard.flash_serviceable(m1, 8)    # tp == 1
+        monkeypatch.setenv(kernel_shard.ENV_KILL, "0")
+        assert not kernel_shard.flash_serviceable(mesh, 8)  # killed
+
+    def test_ffn_tp_serviceable(self, requires_devices, devices8,
+                                monkeypatch):
+        requires_devices(8)
+        mesh = make_mesh(("dp", "tp"), (4, 2), devices8)
+        assert kernel_shard.ffn_tp_serviceable(mesh, 64, 16)
+        assert not kernel_shard.ffn_tp_serviceable(mesh, 63, 16)
+        assert not kernel_shard.ffn_tp_serviceable(mesh, 64, 15)
+        monkeypatch.setenv(kernel_shard.ENV_KILL, "0")
+        assert not kernel_shard.ffn_tp_serviceable(mesh, 64, 16)
+
+    def test_quant_tp_serviceable_and_routed(self, requires_devices,
+                                             devices8, monkeypatch):
+        requires_devices(8)
+        mesh = make_mesh(("dp", "tp"), (4, 2), devices8)
+        assert kernel_shard.quant_tp_serviceable(mesh, 1, (16, 32))
+        assert kernel_shard.quant_tp_serviceable(mesh, 0, (16, 32))
+        assert not kernel_shard.quant_tp_serviceable(mesh, None, (16, 32))
+        assert not kernel_shard.quant_tp_serviceable(mesh, 1, (16, 33))
+        assert not kernel_shard.quant_tp_serviceable(mesh, 5, (16, 32))
+        # use_pallas=False = the registered fallback: NOT routed
+        assert not kernel_shard.quant_tp_routed(mesh, 1, (16, 32), False)
+        assert kernel_shard.quant_tp_routed(mesh, 1, (16, 32), None)
+        monkeypatch.setenv(kernel_shard.ENV_KILL, "0")
+        assert not kernel_shard.quant_tp_routed(mesh, 1, (16, 32), None)
+
+
+# -------------------------------------------------------------------------
+# flash attention: head-sharded over tp
+# -------------------------------------------------------------------------
+
+class TestFlashHeadSharded:
+    def _qkvm(self, B=8, H=4, L=16, D=8, seed=0, masked=True):
+        rr = np.random.default_rng(seed)
+        q, k, v = (jnp.asarray(rr.normal(size=(B, H, L, D)), jnp.float32)
+                   for _ in range(3))
+        mask = None
+        if masked:
+            lens = rr.integers(L // 2, L + 1, size=(B,))
+            mask = jnp.asarray(
+                (np.arange(L)[None, :] < lens[:, None]).astype(np.int32)
+            )[:, None, None, :]
+        return q, k, v, mask
+
+    @pytest.mark.parametrize("mesh_spec", [(("dp", "tp"), (2, 2)),
+                                           (("dp", "tp"), (1, 4))])
+    def test_matches_unsharded_kernel(self, mesh_spec, requires_devices,
+                                      devices8):
+        """The sharded wrapper runs the SAME kernel on each device's
+        local heads — attention is independent per (b, h), so the
+        result matches the unsharded call within the flash parity pin
+        (rtol 2e-5, the test_mesh2d dense-vs-sp bound)."""
+        requires_devices(8)
+        from faster_distributed_training_tpu.ops.flash_attention import (
+            flash_attention)
+        axes, shape = mesh_spec
+        mesh = make_mesh(axes, shape, devices8[:int(np.prod(shape))])
+        q, k, v, mask = self._qkvm()
+        ref = flash_attention(q, k, v, mask=mask)
+        with mesh:
+            got = kernel_shard.flash_attention_sharded(q, k, v, mask,
+                                                       mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6,
+                                   err_msg=str(mesh_spec))
+
+    def test_dropout_masks_are_placement_invariant(self, requires_devices,
+                                                   devices8):
+        """The in-kernel hash dropout addresses GLOBAL (b, h) stream
+        indices via _pack_seed/bh0 — the SAME seed draws the SAME mask
+        at any tp layout, so sharded == unsharded drop pattern exactly
+        (the codebase's sharded-dropout contract)."""
+        requires_devices(8)
+        from faster_distributed_training_tpu.ops.flash_attention import (
+            flash_attention)
+        mesh = make_mesh(("dp", "tp"), (2, 2), devices8[:4])
+        q, k, v, mask = self._qkvm(seed=1)
+        seed = jnp.uint32(123)
+        ref = np.asarray(flash_attention(q, k, v, mask=mask,
+                                         dropout_rate=0.35,
+                                         dropout_seed=seed))
+        with mesh:
+            got = np.asarray(kernel_shard.flash_attention_sharded(
+                q, k, v, mask, mesh, dropout_rate=0.35,
+                dropout_seed=seed))
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
+        # a DIFFERENT layout over the same devices draws the same mask
+        mesh4 = make_mesh(("dp", "tp"), (1, 4), devices8[:4])
+        with mesh4:
+            got4 = np.asarray(kernel_shard.flash_attention_sharded(
+                q, k, v, mask, mesh4, dropout_rate=0.35,
+                dropout_seed=seed))
+        np.testing.assert_allclose(got4, ref, rtol=2e-5, atol=2e-6)
+
+    def test_gradients_match_unsharded(self, requires_devices, devices8):
+        requires_devices(8)
+        from faster_distributed_training_tpu.ops.flash_attention import (
+            flash_attention)
+        mesh = make_mesh(("dp", "tp"), (2, 2), devices8[:4])
+        q, k, v, mask = self._qkvm(B=4, H=2, L=8, seed=2)
+
+        def loss_ref(q_, k_, v_):
+            return jnp.sum(flash_attention(q_, k_, v_, mask=mask) ** 2)
+
+        def loss_sh(q_, k_, v_):
+            return jnp.sum(kernel_shard.flash_attention_sharded(
+                q_, k_, v_, mask, mesh) ** 2)
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        with mesh:
+            g_sh = jax.grad(loss_sh, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g_sh, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=f"d{name}")
+
+    def test_non_dividing_heads_raise(self, requires_devices, devices8):
+        requires_devices(8)
+        mesh = make_mesh(("dp", "tp"), (2, 2), devices8[:4])
+        q, k, v, _ = self._qkvm(H=3, masked=False)
+        with pytest.raises(ValueError, match="divides"):
+            kernel_shard.flash_attention_sharded(q, k, v, None, mesh)
+
+
+# -------------------------------------------------------------------------
+# fused FFN: Megatron column-then-row over tp
+# -------------------------------------------------------------------------
+
+class TestFFNMegatronTp:
+    def _inputs(self, dtype=jnp.float32, B=8, L=16, d=32, dff=64, seed=0):
+        rr = np.random.default_rng(seed)
+        h = jnp.asarray(rr.normal(size=(B, L, d)), dtype)
+        lns = jnp.asarray(rr.normal(size=(d,)) * 0.1 + 1.0, jnp.float32)
+        lnb = jnp.asarray(rr.normal(size=(d,)) * 0.1, jnp.float32)
+        w1 = jnp.asarray(rr.normal(size=(d, dff)) * 0.1, dtype)
+        b1 = jnp.asarray(rr.normal(size=(dff,)) * 0.1, dtype)
+        w2 = jnp.asarray(rr.normal(size=(dff, d)) * 0.1, dtype)
+        b2 = jnp.asarray(rr.normal(size=(d,)) * 0.1, dtype)
+        return h, lns, lnb, w1, b1, w2, b2
+
+    @pytest.mark.parametrize("mesh_spec", [(("dp", "tp"), (2, 2)),
+                                           (("dp", "sp", "tp"), (2, 2, 2))])
+    def test_matches_unsharded_sublayer(self, mesh_spec, requires_devices,
+                                        devices8):
+        """Column-then-row with ONE psum == the unsharded fused sublayer
+        (the existing fused-FFN parity pin rtol 1e-5) — including on a
+        mesh with a dedicated sp axis (output sequence-sharded over
+        (sp, tp))."""
+        requires_devices(8)
+        from faster_distributed_training_tpu.ops.fused_ffn import (
+            fused_ffn_sublayer)
+        axes, shape = mesh_spec
+        mesh = make_mesh(axes, shape, devices8[:int(np.prod(shape))])
+        args = self._inputs()
+        s1, s2 = jnp.uint32(3), jnp.uint32(4)
+        ref = fused_ffn_sublayer(*args, s1, s2, 0.0, 0.0)
+        with mesh:
+            got = kernel_shard.fused_ffn_sublayer_tp(*args, s1, s2,
+                                                     mesh=mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=str(mesh_spec))
+
+    def test_dropout_placement_invariant_and_grads(self, requires_devices,
+                                                   devices8):
+        """Hidden dropout on GLOBAL d_ff columns (col0/cols_glob), conn
+        dropout on the shard's own sequence slice — identical drop
+        pattern to the unsharded kernel, gradients within the existing
+        fused-FFN backward pin (rtol 1e-4)."""
+        requires_devices(8)
+        from faster_distributed_training_tpu.ops.fused_ffn import (
+            fused_ffn_sublayer)
+        mesh = make_mesh(("dp", "tp"), (2, 2), devices8[:4])
+        args = self._inputs(seed=1)
+        s1, s2 = jnp.uint32(7), jnp.uint32(9)
+        ref_d = np.asarray(fused_ffn_sublayer(*args, s1, s2, 0.4, 0.3))
+        with mesh:
+            got_d = np.asarray(kernel_shard.fused_ffn_sublayer_tp(
+                *args, s1, s2, mesh=mesh, rate_hidden=0.4, rate_conn=0.3))
+        np.testing.assert_array_equal(got_d == 0.0, ref_d == 0.0)
+        np.testing.assert_allclose(got_d, ref_d, rtol=1e-5, atol=1e-6)
+
+        gp = jax.grad(lambda h: jnp.sum(
+            fused_ffn_sublayer(h, *args[1:], s1, s2, 0.4, 0.3) ** 2)
+        )(args[0])
+        with mesh:
+            gs = jax.grad(lambda h: jnp.sum(
+                kernel_shard.fused_ffn_sublayer_tp(
+                    h, *args[1:], s1, s2, mesh=mesh, rate_hidden=0.4,
+                    rate_conn=0.3) ** 2))(args[0])
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(gp),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_quantized_sublayer_matches_and_amax_globalizes(
+            self, requires_devices, devices8):
+        """--quant through the tp sublayer: the per-shard generalized
+        kernel quantizes both GEMMs at the GLOBAL delayed scales; the
+        output matches the unsharded quantized core and the returned
+        (2,) amaxes equal the unsharded ones (amax_a pmax'd over its
+        column shards)."""
+        requires_devices(8)
+        from faster_distributed_training_tpu.ops.fused_ffn import (
+            ffn_core_generalized)
+        mesh = make_mesh(("dp", "tp"), (2, 2), devices8[:4])
+        h, lns, lnb, w1, b1, w2, b2 = self._inputs(seed=2)
+        scales = tuple(jnp.float32(s) for s in (11.0, 90.0, 7.0, 80.0))
+        ref, amax_ref = ffn_core_generalized(
+            h, lns, lnb, w1, b1, w2, b2, 0, 0, 0, 0, 0, 0.0, 0.0, 1e-6,
+            1, 1, dff_glob=w1.shape[1], quant_fmt="int8",
+            quant_scales=scales)
+        with mesh:
+            got, amax_got = kernel_shard.fused_ffn_sublayer_tp(
+                h, lns, lnb, w1, b1, w2, b2, 0, 0, mesh=mesh,
+                quant_fmt="int8", quant_scales=scales)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(amax_got),
+                                   np.asarray(amax_ref),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_unserviceable_shapes_raise(self, requires_devices, devices8):
+        requires_devices(8)
+        mesh = make_mesh(("dp", "tp"), (2, 2), devices8[:4])
+        args = self._inputs(L=15)              # 15 % 2 != 0
+        with pytest.raises(ValueError, match="cannot serve"):
+            kernel_shard.fused_ffn_sublayer_tp(*args, jnp.uint32(0),
+                                               jnp.uint32(0), mesh=mesh)
+
+
+# -------------------------------------------------------------------------
+# quant matmul: column/row-parallel per the site's TP rule
+# -------------------------------------------------------------------------
+
+class TestQuantDenseSharded:
+    def _operands(self, m=16, k=32, feats=(24,), seed=0, fmt="int8"):
+        rr = np.random.default_rng(seed)
+        x = jnp.asarray(rr.normal(size=(m, k)), jnp.float32)
+        w = jnp.asarray(rr.normal(size=(k,) + feats) * 0.1, jnp.float32)
+        mk = lambda t: Q.scale_from_history(
+            Q.update_amax_history(Q.fresh_amax_history(4),
+                                  Q.tensor_amax(t)), fmt)
+        return x, w, mk(x), mk(w)
+
+    @pytest.mark.parametrize("fmt", ["int8", "fp8"])
+    def test_column_parallel_matches_reference(self, fmt,
+                                               requires_devices,
+                                               devices8):
+        """tp_dim=1 (Megatron column-parallel, the qkv/Dense_0 role):
+        each shard contracts its w columns locally, output columns
+        tp-sharded, NO collective — equals the full-array quant_dot."""
+        requires_devices(8)
+        mesh = make_mesh(("dp", "tp"), (2, 2), devices8[:4])
+        x, w, sx, sw = self._operands(fmt=fmt)
+        ref = Q.quant_dot(x, w.reshape(32, -1), sx, sw, fmt,
+                          use_pallas=False)
+        with mesh:
+            got = kernel_shard.quant_dense_sharded(x, w, sx, sw, fmt,
+                                                   mesh, tp_dim=1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_row_parallel_one_psum_matches_reference(self,
+                                                     requires_devices,
+                                                     devices8):
+        """tp_dim=0 (row-parallel, the out-proj/Dense_1 role): each
+        shard contracts its local K rows, ONE psum recombines — descale
+        is linear, so psum-of-dequantized equals the full contraction
+        up to fp32 summation order (tight allclose, not bitwise)."""
+        requires_devices(8)
+        mesh = make_mesh(("dp", "tp"), (2, 2), devices8[:4])
+        x, w, sx, sw = self._operands(seed=1)
+        ref = Q.quant_dot(x, w.reshape(32, -1), sx, sw, "int8",
+                          use_pallas=False)
+        with mesh:
+            got = kernel_shard.quant_dense_sharded(x, w, sx, sw, "int8",
+                                                   mesh, tp_dim=0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_multifeat_kernel_sharded_on_head_axis(self, requires_devices,
+                                                   devices8):
+        """The fused-qkv site: kernel (d, 3, H, d_k) with tp_dim=2 —
+        the head axis shards, the flat result matches the reference."""
+        requires_devices(8)
+        mesh = make_mesh(("dp", "tp"), (2, 2), devices8[:4])
+        x, w, sx, sw = self._operands(feats=(3, 4, 8), seed=2)
+        ref = Q.quant_dot(x, w.reshape(32, -1), sx, sw, "int8",
+                          use_pallas=False)
+        with mesh:
+            got = kernel_shard.quant_dense_sharded(x, w, sx, sw, "int8",
+                                                   mesh, tp_dim=2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_gradients_match_reference(self, requires_devices, devices8):
+        requires_devices(8)
+        mesh = make_mesh(("dp", "tp"), (2, 2), devices8[:4])
+        x, w, sx, sw = self._operands(seed=3)
+
+        def loss_ref(x_, w_):
+            return jnp.sum(Q.quant_dot(x_, w_.reshape(32, -1), sx, sw,
+                                       "int8", use_pallas=False) ** 2)
+
+        def loss_sh(x_, w_):
+            return jnp.sum(kernel_shard.quant_dense_sharded(
+                x_, w_, sx, sw, "int8", mesh, tp_dim=1) ** 2)
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+        with mesh:
+            g_sh = jax.grad(loss_sh, argnums=(0, 1))(x, w)
+        for name, a, b in zip(("dx", "dw"), g_sh, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a).reshape(np.shape(b)), np.asarray(b),
+                rtol=1e-5, atol=1e-6, err_msg=name)
+
+    def test_e5m2_grad_path_under_shard_map(self, requires_devices,
+                                            devices8):
+        """--quant_grad fp8_e5m2 inside the shard_map boundary: the
+        cotangent amax pmaxes over the sharded axes (grad_axes), so the
+        JIT per-tensor scale — and thus the quantized gradients — are
+        placement-invariant vs the unsharded quantized backward."""
+        requires_devices(8)
+        mesh = make_mesh(("dp", "tp"), (2, 2), devices8[:4])
+        x, w, sx, sw = self._operands(seed=4, fmt="fp8")
+
+        def loss_ref(x_, w_):
+            return jnp.sum(Q.quant_dot(x_, w_.reshape(32, -1), sx, sw,
+                                       "fp8", use_pallas=False,
+                                       grad_fmt="fp8_e5m2") ** 2)
+
+        def loss_sh(x_, w_):
+            return jnp.sum(kernel_shard.quant_dense_sharded(
+                x_, w_, sx, sw, "fp8", mesh, tp_dim=1,
+                grad_fmt="fp8_e5m2") ** 2)
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+        with mesh:
+            g_sh = jax.grad(loss_sh, argnums=(0, 1))(x, w)
+        for name, a, b in zip(("dx", "dw"), g_sh, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a).reshape(np.shape(b)), np.asarray(b),
+                rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+# -------------------------------------------------------------------------
+# acceptance: zero capability-fallback warnings on dp=2,tp=2
+# -------------------------------------------------------------------------
+
+_FALLBACK_PHRASES = ("cannot run head-sharded",
+                     "cannot run the Megatron",
+                     "cannot run column/row-sharded",
+                     "cannot partition over the tp axis",
+                     "does not compose",
+                     "does not support tensor-parallel")
+
+
+class TestZeroFallbackWarnings:
+    """The ISSUE acceptance sentence, verbatim: on a dp=2,tp=2 simulated
+    mesh, build_model emits zero capability-fallback warnings for
+    --attention flash, --ffn_impl pallas, and --quant {int8,fp8} when
+    shapes divide tp — 'fast' and 'scaled' are the same config now."""
+
+    def _cfg(self, **kw):
+        base = dict(model="transformer", dataset="synthetic",
+                    num_classes=4, batch_size=8, seq_len=16, n_layers=1,
+                    d_model=16, d_ff=32, n_heads=2, precision="fp32")
+        base.update(kw)
+        return TrainConfig(**base)
+
+    @pytest.mark.parametrize("kw,expect", [
+        (dict(attention="flash"), ("attention_impl", "flash")),
+        (dict(ffn_impl="pallas"), ("ffn_impl", "pallas")),
+        (dict(quant="int8", attention="dense"), ("quant", "int8")),
+        (dict(quant="fp8", attention="dense"), ("quant", "fp8")),
+        (dict(quant="int8", ffn_impl="pallas", attention="flash"),
+         ("ffn_impl", "pallas")),       # the full composition
+    ])
+    def test_no_capability_fallback_warned(self, kw, expect,
+                                           requires_devices, devices8):
+        requires_devices(8)
+        from faster_distributed_training_tpu.cli import build_model
+        mesh = make_mesh(("dp", "tp"), (2, 2), devices8[:4])
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            model = build_model(self._cfg(**kw), vocab_size=64, mesh=mesh)
+        hit = [str(r.message) for r in rec
+               if any(p in str(r.message) for p in _FALLBACK_PHRASES)]
+        assert hit == [], (kw, hit)
+        attr, want = expect
+        got = getattr(model, attr)
+        if attr == "quant":
+            assert got is not None and got.fmt == want
+            assert got.use_pallas is None      # kernel routing kept
+        else:
+            assert got == want, (kw, got)
+
+
+# -------------------------------------------------------------------------
+# e2e: the sharded kernels through the real train step + K-dispatch
+# -------------------------------------------------------------------------
+
+def _tiny_cfg(tmp, **kw):
+    base = dict(model="transformer", dataset="synthetic", num_classes=4,
+                batch_size=8, seq_len=16, n_layers=1, d_model=16, d_ff=32,
+                n_heads=2, epochs=1, subset_stride=128, optimizer="sgd",
+                precision="fp32", plot=False, workers=0, log_every=0,
+                donate=False, checkpoint_dir=str(tmp))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+class TestE2ETrain2D:
+    """run_training on dp=2,tp=2 with the recovered kernels ON: the
+    loss curve stays allclose to the forced-fallback twin (the r11
+    parity protocol), and r8's K=4 fused dispatch twins K=1 with the
+    sharded kernels in the scan."""
+
+    def _run(self, tmp, **kw):
+        from faster_distributed_training_tpu.cli import run_training
+        return run_training(_tiny_cfg(tmp, **kw), log=lambda *_: None)
+
+    MESH = dict(mesh_axes=("dp", "tp"), mesh_shape=(2, 2))
+
+    @pytest.fixture(scope="class")
+    def run_kernel(self, tmp_path_factory, requires_devices):
+        requires_devices(8)
+        return self._run(tmp_path_factory.mktemp("k_on"),
+                         attention="flash", quant="int8", **self.MESH)
+
+    def test_flash_quant_tp_matches_forced_fallback(self, run_kernel,
+                                                    tmp_path,
+                                                    monkeypatch):
+        """FDT_KERNEL_SHARD=0 (the bench A/B arm) must reproduce the
+        same training trajectory within the r11 2D parity pin — the
+        shard_map layer changes the program, not the math."""
+        monkeypatch.setenv(kernel_shard.ENV_KILL, "0")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ref = self._run(tmp_path, attention="flash", quant="int8",
+                            **self.MESH)
+        got = run_kernel
+        np.testing.assert_allclose(got["history"]["train_loss"],
+                                   ref["history"]["train_loss"],
+                                   rtol=2e-4)
+        _tree_allclose(got["state"].params, ref["state"].params,
+                       rtol=5e-4, atol=1e-6)
+
+    def test_fused_dispatch_k4_twins_k1_flash(self, tmp_path):
+        """K=4 vs K=1 with the head-sharded flash kernel on — same
+        mesh, same kernels, the r8 contract at the r11 2D pin: the scan
+        and unfused programs are different SPMD partitionings whose
+        fp32 islands XLA:CPU fuses differently (~1 ULP/step, measured
+        1.3e-7 at this harness — the class test_mesh2d records), so the
+        cross-PROGRAM pin is tight-allclose; within-program determinism
+        stays bitwise via the kill-at-N resume pins."""
+        k1 = self._run(tmp_path / "k1", attention="flash", **self.MESH)
+        k4 = self._run(tmp_path / "k4", attention="flash",
+                       steps_per_dispatch=4, **self.MESH)
+        assert int(k1["state"].step) == int(k4["state"].step) == 4
+        _tree_allclose(k1["state"].params, k4["state"].params,
+                       rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(k1["history"]["train_loss"],
+                                   k4["history"]["train_loss"],
+                                   rtol=1e-5)
+
+    def test_fused_dispatch_k4_twins_k1_quant(self, run_kernel,
+                                              tmp_path):
+        """The quant K-twin on tp is GRID-STEP-bounded, not bitwise —
+        a measured, PRE-EXISTING property (reproduced at HEAD with the
+        r13 fallback path, kill switch on): quantization's rounding
+        cliffs amplify the scan-vs-unfused ~1 ULP activation noise
+        above into ~one int8 grid step when an amax lands near a
+        rounding boundary (max() itself is exact — the amax state
+        inherits the activations' ULPs).  1D meshes stay bitwise
+        (test_quant's K-twin: identical fusion, identical ULPs); on tp
+        the honest pin is one grid step of the quantized tensors'
+        scale, and the loss curves must stay in the same noise band."""
+        k1 = run_kernel
+        k4 = self._run(tmp_path / "k4", attention="flash", quant="int8",
+                       steps_per_dispatch=4, **self.MESH)
+        assert int(k1["state"].step) == int(k4["state"].step) == 4
+        # measured 1.04e-2 max param drift at this harness = ~1 grid
+        # step of the largest-amax site; bound at 3 grid steps of the
+        # coarsest observed scale so the pin flags a REAL regression
+        # (structurally different masks/scales), not the known class
+        amax = max(float(np.max(np.asarray(l)))
+                   for l in jax.tree.leaves(k1["state"].batch_stats))
+        grid = max(amax, 1.0) / 127.0
+        for a, b in zip(jax.tree.leaves(k1["state"].params),
+                        jax.tree.leaves(k4["state"].params)):
+            assert float(np.max(np.abs(np.asarray(a) - np.asarray(b)))) \
+                <= 3 * grid
+        np.testing.assert_allclose(k1["history"]["train_loss"],
+                                   k4["history"]["train_loss"],
+                                   rtol=2e-3)
+
+
+# -------------------------------------------------------------------------
+# the routing lint (tier-1 wiring)
+# -------------------------------------------------------------------------
+
+class TestKernelRoutingLint:
+    def test_repo_is_clean(self):
+        lint = _load_script("check_kernel_routing")
+        assert lint.check() == []
+
+    def test_unregistered_kernel_module_flagged(self, tmp_path):
+        lint = _load_script("check_kernel_routing")
+        (tmp_path / "sneaky.py").write_text(
+            "from jax.experimental import pallas as pl\n"
+            "def k(r): pass\n"
+            "def launch(x):\n"
+            "    return pl.pallas_call(k, out_shape=x)(x)\n")
+        problems = lint.check(package_dir=str(tmp_path))
+        assert any(p.startswith("rule 1") and "sneaky.py" in p
+                   for p in problems), problems
+
+    def test_unregistered_call_site_flagged(self, tmp_path):
+        lint = _load_script("check_kernel_routing")
+        (tmp_path / "rogue_caller.py").write_text(
+            "from faster_distributed_training_tpu.ops.flash_attention "
+            "import flash_attention\n"
+            "def f(q, k, v):\n"
+            "    return flash_attention(q, k, v)\n")
+        problems = lint.check(package_dir=str(tmp_path))
+        assert any(p.startswith("rule 2") and "flash_attention" in p
+                   and "rogue_caller.py" in p for p in problems), problems
+
+    def test_stale_registry_entry_flagged(self, tmp_path):
+        lint = _load_script("check_kernel_routing")
+        (tmp_path / "empty.py").write_text("x = 1\n")
+        problems = lint.check(package_dir=str(tmp_path))
+        # every ALLOWED_CALLERS pair is absent from the scratch package:
+        # rule 3 reports the rot instead of silently passing
+        assert any(p.startswith("rule 3") for p in problems)

@@ -299,8 +299,13 @@ class TestRingUlyssesOverTpAxis:
                                                               mask)),
                                    rtol=2e-5, atol=2e-5)
 
-    def test_build_model_flash_tp_fallback(self, requires_devices,
-                                           devices8):
+    def test_build_model_flash_tp_routing(self, requires_devices,
+                                          devices8, monkeypatch):
+        """r19: flash on a serviceable tp mesh (heads divide tp) KEEPS
+        the kernel — routed head-sharded through parallel/kernel_shard
+        — with no capability warning; the warned sequence-parallel
+        fallback survives for non-dividing heads and under the
+        FDT_KERNEL_SHARD=0 kill switch."""
         requires_devices(8)
         from faster_distributed_training_tpu.cli import build_model
         mesh = make_mesh(("dp", "tp"), (4, 2), devices8)
@@ -310,10 +315,24 @@ class TestRingUlyssesOverTpAxis:
         with warnings.catch_warnings(record=True) as rec:
             warnings.simplefilter("always")
             model = build_model(cfg, vocab_size=64, mesh=mesh)
-        assert model.attention_impl == "ulysses"   # h=2, seq=16 divide tp
-        assert model.sp_axis == "tp"
-        assert any("cannot partition over the tp axis" in str(w.message)
+        assert model.attention_impl == "flash"    # h=2 divides tp=2
+        assert not any("flash" in str(w.message).lower() for w in rec)
+        # non-dividing heads: the REGISTERED warned fallback remains
+        cfg1 = cfg.replace(n_heads=1)
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            model1 = build_model(cfg1, vocab_size=64, mesh=mesh)
+        assert model1.attention_impl in ("ring", "ulysses", "dense")
+        assert any("cannot run head-sharded" in str(w.message)
                    for w in rec)
+        # kill switch restores the pre-r19 reroute (the bench A/B arm)
+        monkeypatch.setenv("FDT_KERNEL_SHARD", "0")
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            model0 = build_model(cfg, vocab_size=64, mesh=mesh)
+        assert model0.attention_impl == "ulysses"  # h=2, seq=16 divide tp
+        assert model0.sp_axis == "tp"
+        assert any("FDT_KERNEL_SHARD=0" in str(w.message) for w in rec)
 
 
 class TestTrain2D:

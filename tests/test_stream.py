@@ -380,6 +380,112 @@ class TestLMObjective:
             make_train_step(TrainConfig(model="resnet18", task="lm"))
 
 
+class TestTiedLMHead:
+    """r19 satellite (ROADMAP r18 follow-on (c)): the LM head ties to
+    token_embedding by default (logits = h @ E^T — ~vocab*d_model fewer
+    params, the vocab-sharding TP rule serves the head for free);
+    --untie_lm_head restores the r18 separate projection, and untied
+    r18 checkpoints restore into tied models via a warned compat shim
+    (train/checkpoint.py)."""
+
+    V = 50
+
+    def _state(self, tied: bool, seed=0):
+        from faster_distributed_training_tpu.cli import build_model
+        from faster_distributed_training_tpu.optim import build_optimizer
+        from faster_distributed_training_tpu.train import (
+            create_train_state)
+        cfg = TrainConfig(model="transformer", task="lm", seq_len=12,
+                          n_layers=1, d_model=16, d_ff=32, n_heads=2,
+                          optimizer="sgd", tie_lm_head=tied)
+        model = build_model(cfg, vocab_size=self.V)
+        tx, _ = build_optimizer(cfg, steps_per_epoch=2)
+        state = create_train_state(model, tx,
+                                   jnp.zeros((2, 12), jnp.int32),
+                                   jax.random.PRNGKey(seed),
+                                   init_kwargs={"train": True})
+        return cfg, model, state
+
+    def test_tied_default_has_no_lm_head_param(self):
+        _cfg, model, state = self._state(tied=True)
+        assert model.tie_lm_head
+        assert "lm_head" not in state.params["model"]
+        _cfg, umodel, ustate = self._state(tied=False)
+        assert not umodel.tie_lm_head
+        assert "lm_head" in ustate.params["model"]
+        # the parameter saving is exactly the projection: V*d + V bias
+        tied_n = sum(l.size for l in jax.tree.leaves(state.params))
+        untied_n = sum(l.size for l in jax.tree.leaves(ustate.params))
+        assert untied_n - tied_n == self.V * 16 + self.V
+
+    def test_tied_logits_come_from_the_embedding_table(self):
+        """Perturbing ONE vocab row of token_embedding moves that
+        row's logit column at every position — the head IS the table
+        (no separate projection to absorb the change)."""
+        _cfg, model, state = self._state(tied=True)
+        tokens = jnp.ones((2, 12), jnp.int32)
+        params = state.params["model"]
+        base = model.apply({"params": params}, tokens, train=False)
+        assert base.shape == (2, 12, self.V)
+        emb = params["Embeddings_0"]["token_embedding"]
+        bumped = jax.tree_util.tree_map(lambda x: x, params)
+        bumped["Embeddings_0"]["token_embedding"] = emb.at[7].add(100.0)
+        out = model.apply({"params": bumped}, tokens, train=False)
+        # column 7 moved; distant columns move only through the
+        # (token==7) embedding sum — tokens here are all 1s, so rows
+        # never embed vocab 7 and ONLY the tied head sees the bump
+        assert np.any(np.asarray(out[..., 7]) != np.asarray(base[..., 7]))
+        np.testing.assert_array_equal(np.asarray(out[..., :7]),
+                                      np.asarray(base[..., :7]))
+
+    def test_untie_flag_round_trips_config(self):
+        from faster_distributed_training_tpu.config import (
+            build_parser, config_from_args)
+        cfg = config_from_args(build_parser().parse_args(
+            ["--model", "transformer", "--task", "lm"]))
+        assert cfg.tie_lm_head
+        cfg = config_from_args(build_parser().parse_args(
+            ["--model", "transformer", "--task", "lm",
+             "--untie_lm_head"]))
+        assert not cfg.tie_lm_head
+
+    def test_untied_r18_checkpoint_restores_into_tied_model(self,
+                                                            tmp_path):
+        """The compat shim: an UNTIED checkpoint restores into a tied
+        template by DROPPING the projection (params + opt_state),
+        warned; every shared leaf round-trips exactly."""
+        from faster_distributed_training_tpu.train import checkpoint as \
+            ckpt
+        _cfg, _m, untied = self._state(tied=False, seed=1)
+        ckpt.save_checkpoint(str(tmp_path), "r18", untied, epoch=2,
+                             best_acc=0.5)
+        _cfg, _m, tied_tmpl = self._state(tied=True, seed=2)
+        with pytest.warns(UserWarning, match="untied-lm-head"):
+            restored, epoch, best = ckpt.restore_checkpoint(
+                str(tmp_path), "r18", tied_tmpl)
+        assert epoch == 2 and np.isclose(best, 0.5)
+        assert "lm_head" not in restored.params["model"]
+        src = {k: v for k, v in untied.params["model"].items()
+               if k != "lm_head"}
+        _assert_tree_equal(restored.params["model"], src)
+
+    def test_untied_checkpoint_restores_untied_exactly(self, tmp_path):
+        """--untie_lm_head keeps the r18 behavior: same-layout restore
+        is exact, no shim, no warning."""
+        from faster_distributed_training_tpu.train import checkpoint as \
+            ckpt
+        _cfg, _m, untied = self._state(tied=False, seed=3)
+        ckpt.save_checkpoint(str(tmp_path), "r18", untied, epoch=1,
+                             best_acc=0.25)
+        _cfg, _m, tmpl = self._state(tied=False, seed=4)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            restored, _e, _b = ckpt.restore_checkpoint(str(tmp_path),
+                                                       "r18", tmpl)
+        _assert_tree_equal(restored.params, untied.params)
+        _assert_tree_equal(restored.opt_state, untied.opt_state)
+
+
 # -- e2e: streamed training bitwise vs resident; kill-at-N resume ---------
 
 def _lm_cfg(stream_dir, ckpt, **kw):

@@ -320,11 +320,13 @@ def expect_route(bs, seq, cond):
         TrainConfig(seq_len=seq, batch_size=bs, n_heads=heads), mesh)
 
 
-def test_ffn_impl_pallas_mesh_routing(devices8):
+def test_ffn_impl_pallas_mesh_routing(devices8, monkeypatch):
     """--ffn_impl pallas: data-sharded meshes (dp/fsdp/sp) keep the
-    kernel (shard_map per-shard path, mesh handed to the model); a
-    tp-sharded mesh falls back to flax loudly (tensor-parallel FFN
-    weights would be gathered per step)."""
+    kernel (shard_map per-shard path, mesh handed to the model); since
+    r19 tp meshes ALSO keep it (Megatron column/row tiles through
+    parallel/kernel_shard.py) when d_ff/seq divide — the flax
+    composition survives only as the registered warned fallback
+    (non-dividing shapes, or FDT_KERNEL_SHARD=0)."""
     import warnings as _w
 
     from faster_distributed_training_tpu.cli import build_model
@@ -336,17 +338,33 @@ def test_ffn_impl_pallas_mesh_routing(devices8):
                       ffn_impl="pallas")
     for axes, shape, expect in ((("dp",), (8,), "pallas"),
                                 (("dp", "sp"), (1, 8), "pallas"),
-                                (("dp", "tp"), (1, 8), "flax"),
+                                (("dp", "tp"), (1, 8), "pallas"),
                                 (("dp",), (1,), "pallas")):
         mesh = make_mesh(axes, shape, devices8[:int(np.prod(shape))])
         with _w.catch_warnings(record=True) as rec:
             _w.simplefilter("always")
             model = build_model(cfg, vocab_size=32, mesh=mesh)
         assert model.ffn_impl == expect, (axes, shape)
-        if expect == "flax":
-            assert any("tensor-parallel" in str(r.message) for r in rec)
-        elif any(s > 1 for s in shape):
+        assert not any("falling back to the flax" in str(r.message)
+                       for r in rec), (axes, shape)
+        if any(s > 1 for s in shape):
             assert model.mesh is mesh   # the sharded path needs the mesh
+    # non-dividing seq (seq=12 doesn't divide tp=8): warned fallback
+    mesh = make_mesh(("dp", "tp"), (1, 8), devices8)
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        model = build_model(cfg.replace(seq_len=12), vocab_size=32,
+                           mesh=mesh)
+    assert model.ffn_impl == "flax"
+    assert any("cannot run the Megatron" in str(r.message) for r in rec)
+    # kill switch: the pre-r19 reroute comes back (the bench A/B arm)
+    monkeypatch.setenv("FDT_KERNEL_SHARD", "0")
+    mesh = make_mesh(("dp", "tp"), (1, 8), devices8)
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        model = build_model(cfg, vocab_size=32, mesh=mesh)
+    assert model.ffn_impl == "flax"
+    assert any("FDT_KERNEL_SHARD=0" in str(r.message) for r in rec)
 
 
 def test_config_mesh_and_fsdp():
