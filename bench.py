@@ -1109,7 +1109,51 @@ def timed_serve(mix: str) -> dict:
             "qps": out["qps"], "qps_per_chip": out["qps_per_chip"]}
 
 
-def timed_fused(model: str, k: int, bs: int, seq: int, steps: int) -> dict:
+def zero_opt_state_bytes(zero: bool) -> dict:
+    """Per-chip state bytes of the ResNet-50/NGD train state on a
+    dp x tp=2 mesh with the ZeRO opt-state overlay on or off — the
+    post-ZeRO twin of the r15 replicated baseline the tentpole is
+    measured against (no stepping: placement is what's being sized)."""
+    import jax
+    import jax.numpy as jnp
+
+    from faster_distributed_training_tpu.cli import build_model
+    from faster_distributed_training_tpu.config import TrainConfig
+    from faster_distributed_training_tpu.optim import build_optimizer
+    from faster_distributed_training_tpu.parallel import make_mesh
+    from faster_distributed_training_tpu.parallel.placement import (
+        shard_train_state, train_state_shardings)
+    from faster_distributed_training_tpu.telemetry.programs import (
+        state_bytes_table)
+    from faster_distributed_training_tpu.train import create_train_state
+
+    n_dev = jax.device_count()
+    if n_dev < 2:
+        return {"skipped": f"tp=2 sizing needs >=2 chips, host exposes "
+                           f"{n_dev}"}
+    cfg = TrainConfig(model="resnet50", dataset="synthetic",
+                      batch_size=64, use_ngd=True, optimizer="ngd",
+                      precision="bf16", mesh_axes=("dp", "tp"),
+                      mesh_shape=(n_dev // 2, 2), zero_opt=zero)
+    mesh = make_mesh(cfg.mesh_axes, cfg.mesh_shape)
+    model = build_model(cfg)
+    tx, _ = build_optimizer(cfg, steps_per_epoch=10)
+    sample = jnp.zeros((8, 32, 32, 3), jnp.float32)
+    state = create_train_state(model, tx, sample, jax.random.PRNGKey(0),
+                               init_kwargs={"train": True})
+    with mesh:
+        sh = train_state_shardings(state, mesh, cfg)
+        state = shard_train_state(state, mesh, cfg, shardings=sh)
+        table = state_bytes_table(state)
+    return {"zero_opt": bool(zero),
+            "params_bytes_per_chip": int(table["params_bytes_per_chip"]),
+            "opt_state_bytes_per_chip": int(
+                table["opt_state_bytes_per_chip"]),
+            "opt_state_tiers": table.get("opt_state_tiers") or {}}
+
+
+def timed_fused(model: str, k: int, bs: int, seq: int, steps: int,
+                overlap=None, offload: bool = False) -> dict:
     """K-step fused dispatch arm (r8 tentpole): the full train program on
     DEVICE-RESIDENT synthetic data, K steps per dispatch
     (steps.make_fused_train_step over data/device_resident.py) — the
@@ -1117,7 +1161,15 @@ def timed_fused(model: str, k: int, bs: int, seq: int, steps: int) -> dict:
     step_ms / resnet_bs512_k{K}_step_ms arms track.  The K=1 cell is the
     dispatch-per-step floor on the SAME resident path, so the K ladder
     isolates dispatch amortization from data-path effects; uint8 images
-    are augmented in-step (the real pipeline), tokens run as-is."""
+    are augmented in-step (the real pipeline), tokens run as-is.
+
+    overlap (ISSUE 16): None = the legacy ladder program.  True/False =
+    the overlap A/B pair — BOTH arms route through train_state_shardings
+    (the program shape with the ZeRO overlay), differing only in
+    cfg.overlap_grad_reduce, so the pair isolates the bucketed
+    reduce-scatter reshard.  offload=True adds --offload_opt_state (on a
+    backend without pinned_host the step degrades it to off; the arm
+    then measures the same program — read the pair on TPU)."""
     import jax
     import jax.numpy as jnp
 
@@ -1142,7 +1194,9 @@ def timed_fused(model: str, k: int, bs: int, seq: int, steps: int) -> dict:
         model=model, dataset="synthetic", num_classes=4 if is_text else 10,
         batch_size=bs, seq_len=seq or 512, use_ngd=True, optimizer="ngd",
         precision="bf16", epochs=1, steps_per_dispatch=k,
-        data_path="resident", tricks="on"))
+        data_path="resident", tricks="on",
+        overlap_grad_reduce=bool(overlap), offload_opt_state=offload))
+    sharded_state = overlap is not None or offload
     # enough resident steps/epoch to cover ONE K-dispatch in-bounds
     # (dynamic_slice would silently CLAMP an out-of-range start to the
     # last window, re-training the final batch instead of wrapping);
@@ -1164,8 +1218,16 @@ def timed_fused(model: str, k: int, bs: int, seq: int, steps: int) -> dict:
     state = create_train_state(model_obj, tx, sample, rng,
                                init_kwargs={"train": True})
     with mesh:
-        state = shard_train_state(state, mesh, cfg)
-        fused = jax.jit(make_fused_train_step(cfg, k, resident=resident,
+        sh = None
+        if sharded_state:
+            from faster_distributed_training_tpu.parallel.placement import (
+                train_state_shardings)
+            sh = train_state_shardings(state, mesh, cfg)
+            state = shard_train_state(state, mesh, cfg, shardings=sh)
+        else:
+            state = shard_train_state(state, mesh, cfg)
+        fused = jax.jit(make_fused_train_step(cfg, k, state_shardings=sh,
+                                              resident=resident,
                                               mesh=mesh), donate_argnums=0)
         order = resident.epoch_order(0)
         span = max(resident.steps_per_epoch - k + 1, 1)
@@ -1478,6 +1540,12 @@ PRODUCED_METRIC_PATTERNS = (
     # r15 HBM attribution (the ZeRO-item baseline): per-chip bytes of
     # the primary program's train state, params vs optimizer state
     "params_bytes_per_chip", "opt_state_bytes_per_chip",
+    # ISSUE 16 ZeRO tentpole: the dp x tp=2 sizing twins (post-ZeRO vs
+    # forced-replicated opt state; the "resnet_bs512_k*_step_ms" pattern
+    # above also covers the resnet_bs512_k{1,4}_overlap_{on,off}_step_ms
+    # A/B pair), plus the single-run host-offload attribution probe
+    "opt_state_bytes_per_chip_tp2_*", "params_bytes_per_chip_tp2",
+    "opt_state_zero_reduction_x", "opt_offload_step_ms",
     "transformer_agnews_ex_per_sec_*", "transformer_ex_per_sec_*",
     # per-config train arms: EXACT keys, not a transformer_bs*_seq*
     # wildcard — a wildcard here would swallow every future
@@ -1565,6 +1633,10 @@ SINGLE_RUN_STEP_MS = (
     "transformer_bs256_seq256_ln_autodiff_step_ms",
     "transformer_bs64_seq512_flash_recompute_step_ms",
     "ckpt_*_median_step_ms", "ckpt_*_mean_step_ms",
+    # ISSUE 16 offload probe: one-shot attribution arm; its baseline is
+    # resnet_bs512_k1_step_ms published beside it (banding the pair
+    # would re-measure the ladder cell a third time for no information)
+    "opt_offload_step_ms",
 )
 
 # documented intentional trades: still FLAGGED (honesty first) but
@@ -1891,6 +1963,30 @@ def main() -> None:
         dsteps = int(os.environ.get("FDT_BENCH_K_STEPS", "32"))
         print(json.dumps(timed_data_path(child[len("datapath_"):], 512,
                                          dsteps)))
+        return
+    if child.startswith("kov_"):
+        # ISSUE 16 overlap A/B: resnet K-dispatch with the bucketed
+        # gradient reduce-scatter reshard on|off, one (mode, K) cell per
+        # child — both arms run the state_shardings program, only
+        # cfg.overlap_grad_reduce differs
+        _, mode, kk = child.split("_")
+        ksteps = int(os.environ.get("FDT_BENCH_K_STEPS", "32"))
+        print(json.dumps(timed_fused("resnet50", int(kk), 512, 0, ksteps,
+                                     overlap=(mode == "on"))))
+        return
+    if child == "optoffload":
+        # ISSUE 16 host-offload arm: the K=1 resnet program with
+        # --offload_opt_state (pinned_host tiers engage on TPU; on a
+        # host-only backend the step degrades the flag to off and the
+        # arm measures the undegraded twin of resnet_bs512_k1_step_ms)
+        ksteps = int(os.environ.get("FDT_BENCH_K_STEPS", "32"))
+        print(json.dumps(timed_fused("resnet50", 1, 512, 0, ksteps,
+                                     overlap=False, offload=True)))
+        return
+    if child.startswith("zerobytes_"):
+        # ISSUE 16 sizing twins: per-chip opt-state bytes on dp x tp=2
+        # with the ZeRO overlay on ("zero") vs forced replicated ("repl")
+        print(json.dumps(zero_opt_state_bytes(child.endswith("_zero"))))
         return
     if child == "eval_tf":
         print(json.dumps(timed_eval("transformer", 256, 256, tf_steps)))
@@ -2466,6 +2562,45 @@ def main() -> None:
                           if r.get("elapsed") and "stall_s" in r)
             if pcts:
                 record["stream_stall_pct"] = round(pcts[len(pcts) // 2], 2)
+            # ISSUE 16 ZeRO arms (opt out: FDT_BENCH_ZERO=0) — three
+            # pieces: (a) dp x tp=2 sizing twins for the tentpole's
+            # headline (post-ZeRO opt_state_bytes_per_chip vs the forced-
+            # replicated twin, guard class bytes_per_chip); (b) the
+            # overlap reduce-scatter A/B at K in {1,4}, N interleaved
+            # with noise bands like every other *_step_ms pair; (c) the
+            # single-run --offload_opt_state attribution probe.
+            if os.environ.get("FDT_BENCH_ZERO", "1") != "0":
+                zb = {m: _run_child(f"zerobytes_{m}")
+                      for m in ("zero", "repl")}
+                z, rp = zb["zero"], zb["repl"]
+                if z and "opt_state_bytes_per_chip" in z:
+                    record["opt_state_bytes_per_chip_tp2_zero"] = int(
+                        z["opt_state_bytes_per_chip"])
+                    record["params_bytes_per_chip_tp2"] = int(
+                        z["params_bytes_per_chip"])
+                elif z and "skipped" in z:
+                    record["zero_bytes_note"] = z["skipped"]
+                if rp and "opt_state_bytes_per_chip" in rp:
+                    record["opt_state_bytes_per_chip_tp2_replicated"] = \
+                        int(rp["opt_state_bytes_per_chip"])
+                    if z and z.get("opt_state_bytes_per_chip"):
+                        record["opt_state_zero_reduction_x"] = round(
+                            rp["opt_state_bytes_per_chip"]
+                            / z["opt_state_bytes_per_chip"], 2)
+                ov_runs = {(mode, kk): [] for mode in ("on", "off")
+                           for kk in (1, 4)}
+                for _ in range(reps):
+                    for (mode, kk) in ov_runs:
+                        r = _run_child(f"kov_{mode}_{kk}")
+                        if r and "elapsed" in r:
+                            ov_runs[(mode, kk)].append(r)
+                for (mode, kk), rs in ov_runs.items():
+                    _publish(f"resnet_bs512_k{kk}_overlap_{mode}"
+                             f"_step_ms", rs)
+                r = _run_child("optoffload")
+                if r and "elapsed" in r:
+                    record["opt_offload_step_ms"] = round(
+                        r["elapsed"] / r["steps_timed"] * 1e3, 3)
         # Eval throughput under the guard (VERDICT r5 #7): the real
         # pad-and-mask eval step at each workload's headline shape.
         ev = _run_child("eval_resnet")
@@ -2626,6 +2761,12 @@ def _essentials(record: dict) -> dict:
             "transformer_bs256_seq256_k4_step_ms_noise_band_pct",
             "resnet_bs512_k1_step_ms", "resnet_bs512_k4_step_ms",
             "resnet_bs512_k16_step_ms",
+            "opt_state_bytes_per_chip_tp2_zero",
+            "opt_state_bytes_per_chip_tp2_replicated",
+            "opt_state_zero_reduction_x",
+            "resnet_bs512_k4_overlap_on_step_ms",
+            "resnet_bs512_k4_overlap_off_step_ms",
+            "opt_offload_step_ms",
             "data_path_host_step_ms", "data_path_resident_step_ms",
             "data_path_stream_step_ms", "stream_stall_pct",
             "bench_unix_time", "regression_baseline_file")

@@ -862,7 +862,8 @@ def run_training(cfg: TrainConfig,
     from faster_distributed_training_tpu.parallel.mesh import (sp_size,
                                                                tp_size)
     shardings = (train_state_shardings(state, mesh, cfg)
-                 if cfg.host_offload or tp_size(mesh) > 1
+                 if cfg.host_offload or cfg.offload_opt_state
+                 or cfg.overlap_grad_reduce or tp_size(mesh) > 1
                  or sp_size(mesh) > 1 else None)
     state = shard_train_state(state, mesh, cfg, shardings=shardings)
 

@@ -114,6 +114,28 @@ class TrainConfig:
                                       # data axes (ZeroRedundancyOptimizer
                                       # analog, transformer_test.py:4,221-222)
     host_offload: bool = False        # FSDP param offload to host memory
+    zero_opt: bool = True             # ZeRO over tp: shape-aware sharding of
+                                      # the FULL optimizer state wherever the
+                                      # mesh has a tp axis (sharding.py
+                                      # OPT_STATE_RULES); --no_zero_opt
+                                      # restores the r15 replicated layout
+                                      # (the interchange/twin baseline)
+    offload_opt_state: bool = False   # park the big (cold) opt-state leaves
+                                      # in pinned host memory and stream them
+                                      # through the update — the reference's
+                                      # FSDP+CPUOffload row without also
+                                      # offloading params (sharding.py
+                                      # offload_opt_leaf selects the tier)
+    overlap_grad_reduce: bool = False # bucketed gradient reduce-scatter
+                                      # expressed inside the K-dispatch scan
+                                      # so microbatch i's collective hides
+                                      # under i+1's compute.  Value-identity
+                                      # reshard; off by default because the
+                                      # reduce order may shift float bits
+                                      # (the bitwise pins compare flag-off)
+    overlap_bucket_mb: int = 4        # bucket size for --overlap_grad_reduce
+                                      # (DDP's 25 MB default scaled to TPU
+                                      # slice interconnect latency)
     remat: bool = False               # jax.checkpoint the model blocks
     remat_policy: str = "attn_out"    # transformer --remat granularity.
                                       # attn_out (default): whole-layer
@@ -518,6 +540,24 @@ def build_parser(prog: str = "fdt",
                    help="shard only optimizer state over the data axes "
                         "(ZeRO-1; params stay replicated)")
     p.add_argument("--host_offload", action="store_true")
+    p.add_argument("--no_zero_opt", action="store_true",
+                   help="keep the optimizer state replicated over tp (the "
+                        "r15 layout) instead of the default shape-aware "
+                        "ZeRO sharding (sharding.py OPT_STATE_RULES)")
+    p.add_argument("--offload_opt_state", action="store_true",
+                   help="park the big opt-state leaves in pinned host "
+                        "memory and stream them through each update "
+                        "(FSDP+CPUOffload analog without offloading "
+                        "params; no-op where the backend lacks "
+                        "pinned_host)")
+    p.add_argument("--overlap_grad_reduce", action="store_true",
+                   help="lower the gradient reduction as bucketed "
+                        "reduce-scatter inside the K-dispatch scan so "
+                        "microbatch i's collective overlaps i+1's compute "
+                        "(value-identity; reduce order may shift bits)")
+    p.add_argument("--overlap_bucket_mb", default=d.overlap_bucket_mb,
+                   type=int,
+                   help="bucket size (MB) for --overlap_grad_reduce")
     p.add_argument("--remat", action="store_true")
     p.add_argument("--remat_policy", default=d.remat_policy,
                    choices=["ffn", "layer", "attn_out", "dots"],
@@ -802,6 +842,10 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         quant_grad=args.quant_grad,
         tie_lm_head=not args.untie_lm_head,
         fsdp=args.fsdp, zero1=args.zero1, host_offload=args.host_offload,
+        zero_opt=not args.no_zero_opt,
+        offload_opt_state=args.offload_opt_state,
+        overlap_grad_reduce=args.overlap_grad_reduce,
+        overlap_bucket_mb=args.overlap_bucket_mb,
         remat=args.remat, remat_policy=args.remat_policy,
         data_dir=args.data_dir, subset_stride=args.subset_stride, seed=args.seed,
         checkpoint_dir=args.checkpoint_dir, profile=args.profile,

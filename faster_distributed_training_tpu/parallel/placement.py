@@ -68,7 +68,7 @@ def train_state_shardings(state, mesh: Mesh, cfg: TrainConfig):
         specs = jax.tree.map(lambda _: P(), state)
     if "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
         from faster_distributed_training_tpu.parallel.sharding import (
-            param_path_name, tensor_parallel_rules)
+            param_path_name, tensor_parallel_rules, zero_opt_state_specs)
 
         def overlay(path, spec):
             tp_spec = tensor_parallel_rules(param_path_name(path))
@@ -78,16 +78,41 @@ def train_state_shardings(state, mesh: Mesh, cfg: TrainConfig):
             overlay, specs.params["model"],
             is_leaf=lambda x: isinstance(x, P))
         specs = specs.replace(params={**specs.params, "model": model_specs})
+        if getattr(cfg, "zero_opt", True):
+            # ZeRO over tp (ISSUE 16 tentpole): the FULL optimizer state
+            # joins the overlay — shape-aware rules, because NGD factor
+            # states don't mirror param shapes.  The zero spec wins over
+            # the base fsdp/zero1 spec wherever a rule matched.
+            zspecs = zero_opt_state_specs(
+                state.opt_state, state.params, specs.params, mesh,
+                axis="tp")
+            merged = jax.tree.map(
+                lambda z, base: z if z != P() else base,
+                zspecs, specs.opt_state,
+                is_leaf=lambda x: isinstance(x, P))
+            specs = specs.replace(opt_state=merged)
     shardings = jax.tree.map(lambda spec: NamedSharding(mesh, spec), specs,
                              is_leaf=lambda x: isinstance(x, P))
-    if cfg.host_offload and _supports_memory_kind(mesh):
+    offloadable = _supports_memory_kind(mesh)
+    pin = lambda s: NamedSharding(mesh, s.spec,                # noqa: E731
+                                  memory_kind="pinned_host")
+    if cfg.host_offload and offloadable:
         # CPUOffload(offload_params=True) analog: only the big leaves —
         # params and optimizer state — live in host memory.
-        pin = lambda s: NamedSharding(mesh, s.spec,            # noqa: E731
-                                      memory_kind="pinned_host")
         shardings = shardings.replace(
             params=jax.tree.map(pin, shardings.params),
             opt_state=jax.tree.map(pin, shardings.opt_state))
+    elif getattr(cfg, "offload_opt_state", False) and offloadable:
+        # The narrower host tier (--offload_opt_state): only the big,
+        # cold opt-state slots park in host memory; params and the small
+        # hot counters stay resident.  Selection is sharding.offload_opt_leaf
+        # (size floor) so telemetry can attribute the tier per leaf.
+        from faster_distributed_training_tpu.parallel.sharding import (
+            offload_opt_leaf)
+        shardings = shardings.replace(
+            opt_state=jax.tree.map(
+                lambda x, s: pin(s) if offload_opt_leaf(np.shape(x)) else s,
+                state.opt_state, shardings.opt_state))
     return shardings
 
 

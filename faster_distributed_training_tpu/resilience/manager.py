@@ -211,6 +211,11 @@ class AsyncCheckpointManager:
         meta = {"step": int(step), "epoch": int(epoch),
                 "step_in_epoch": int(step_in_epoch),
                 "best_acc": float(best_acc)}
+        # computed from the LIVE device state (the async snapshot below
+        # is host numpy, where every leaf reads as tier "host")
+        layout = ckpt.opt_state_layout(state)
+        if layout:
+            meta["opt_state_layout"] = layout
         name = self._name(step)
         if not (self.async_save or sync):
             sync = True      # async disabled: blocking collective path
@@ -511,6 +516,14 @@ class AsyncCheckpointManager:
                         self.directory, name, state)
                 meta = ckpt.read_checkpoint_meta(self.directory, name,
                                                  backend=self.backend)
+                saved_layout = meta.get("opt_state_layout")
+                live_layout = ckpt.opt_state_layout(restored)
+                if saved_layout and live_layout \
+                        and saved_layout != live_layout:
+                    self._log(f"[ckpt] restore {name}: opt-state layout "
+                              f"changed {saved_layout} -> {live_layout} "
+                              f"(ZeRO<->replicated interchange; values "
+                              f"re-placed by the restore template)")
                 result, restored_step = (restored, meta), step
                 break
             except Exception as e:

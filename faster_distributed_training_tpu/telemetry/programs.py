@@ -492,7 +492,7 @@ STATE_MEMORY_FIELDS = (
     "scope", "params_bytes_per_chip", "params_leaves",
     "opt_state_bytes_per_chip", "opt_state_leaves",
     "batch_stats_bytes_per_chip", "batch_stats_leaves",
-    "total_bytes_per_chip", "top_leaves")
+    "total_bytes_per_chip", "top_leaves", "opt_state_tiers")
 
 
 def leaf_bytes_per_chip(leaf) -> int:
@@ -507,19 +507,39 @@ def leaf_bytes_per_chip(leaf) -> int:
     return int(getattr(leaf, "nbytes", 0))
 
 
+def leaf_tier(leaf) -> str:
+    """Placement tier of one live leaf, for the ZeRO per-leaf
+    attribution: 'offloaded' (pinned_host memory kind), 'sharded'
+    (split across devices), 'replicated' (full copy per chip), or
+    'host' (plain numpy — a restored-not-yet-placed state)."""
+    sh = getattr(leaf, "sharding", None)
+    if sh is None:
+        return "host"
+    if getattr(sh, "memory_kind", None) == "pinned_host":
+        return "offloaded"
+    try:
+        if sh.is_fully_replicated:
+            return "replicated"
+    except Exception:
+        pass
+    return "sharded"
+
+
 def state_bytes_table(state, top: int = 5) -> dict:
     """Per-chip byte attribution of a TrainState, split params vs
     opt_state vs batch_stats.  ``opt_state_bytes_per_chip`` is the
-    number ROADMAP's ZeRO item sizes its win against (momentum/Fisher
-    leaves stay replicated across tp today — the table is the committed
-    baseline that drop will be measured from); ``top_leaves`` names the
-    largest individual leaves so a future sharding rule knows where the
-    bytes live."""
+    number ROADMAP's ZeRO item sized its win against (r15 committed the
+    replicated baseline; the ZeRO overlay's drop is measured from it);
+    ``top_leaves`` names the largest individual leaves with their
+    placement tier, and ``opt_state_tiers`` attributes every opt-state
+    leaf to its sharded/replicated/offloaded tier so the ZeRO layout is
+    auditable per run."""
     import jax
 
     out: dict = {"scope": "state"}
-    sized: List[Tuple[int, str]] = []
+    sized: List[Tuple[int, str, str]] = []
     total = 0
+    tiers: Dict[str, Dict[str, int]] = {}
     for group in ("params", "opt_state", "batch_stats"):
         tree = getattr(state, group, None)
         flat = jax.tree_util.tree_flatten_with_path(tree)[0]
@@ -527,14 +547,21 @@ def state_bytes_table(state, top: int = 5) -> dict:
         for path, leaf in flat:
             n = leaf_bytes_per_chip(leaf)
             b += n
-            sized.append((n, group + jax.tree_util.keystr(path)))
+            tier = leaf_tier(leaf)
+            sized.append((n, group + jax.tree_util.keystr(path), tier))
+            if group == "opt_state":
+                agg = tiers.setdefault(tier,
+                                       {"leaves": 0, "bytes_per_chip": 0})
+                agg["leaves"] += 1
+                agg["bytes_per_chip"] += n
         out[f"{group}_bytes_per_chip"] = b
         out[f"{group}_leaves"] = len(flat)
         total += b
     out["total_bytes_per_chip"] = total
     out["top_leaves"] = [
-        {"path": p, "bytes_per_chip": n}
-        for n, p in sorted(sized, reverse=True)[:top]]
+        {"path": p, "bytes_per_chip": n, "tier": t}
+        for n, p, t in sorted(sized, reverse=True)[:top]]
+    out["opt_state_tiers"] = tiers
     return out
 
 

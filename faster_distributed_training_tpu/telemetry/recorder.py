@@ -58,7 +58,9 @@ by ``"kind"``:
   ``memory``     {scope, ...} — scope "state": the per-chip
                  params/opt_state/batch_stats byte table
                  (programs.state_bytes_table — opt_state_bytes_per_chip
-                 is ROADMAP's ZeRO-sizing number); scope "epoch":
+                 is ROADMAP's ZeRO-sizing number, opt_state_tiers the
+                 per-tier sharded/replicated/offloaded split the ZeRO
+                 overlay is audited by); scope "epoch":
                  device memory watermarks; scope "sharding_drift": the
                  guard fired (expected/got fingerprints + changed
                  leaves under --debug)
@@ -152,8 +154,9 @@ TELEMETRY_SCHEMA: Dict[str, Optional[frozenset]] = {
                          "opt_state_bytes_per_chip", "opt_state_leaves",
                          "batch_stats_bytes_per_chip",
                          "batch_stats_leaves", "total_bytes_per_chip",
-                         "top_leaves", "peak_bytes", "bytes_in_use",
-                         "expected", "got", "changed_leaves"}),
+                         "top_leaves", "opt_state_tiers", "peak_bytes",
+                         "bytes_in_use", "expected", "got",
+                         "changed_leaves"}),
     "flight": frozenset({"path", "reason"}),
     # r16 serving tier (serve/scheduler.py) — append-only additions:
     # one record per dispatched batch, one per fulfilled request

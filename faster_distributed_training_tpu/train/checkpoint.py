@@ -117,6 +117,32 @@ def _state_pytree(state: TrainState) -> Any:
             "loss_scale": state.loss_scale, "rng": state.rng}
 
 
+def opt_state_layout(state) -> dict:
+    """{tier: leaf count} summary of where the optimizer state lives
+    (sharded / replicated / offloaded — telemetry.programs.leaf_tier's
+    vocabulary).  Written into checkpoint meta so an operator can see
+    which ZeRO layout produced a checkpoint; restores compare it against
+    the live template's layout and LOG a mismatch (values interchange
+    freely across layouts — the restore templates re-place them — so a
+    change is informational, never an error).  {} unless some leaf is
+    actually sharded or offloaded: a fully replicated (or plain-numpy
+    host snapshot) layout is the pre-r20 status quo, and recording it
+    would perturb meta for every 1D checkpoint ever written.  {} on any
+    failure too: layout telemetry must never block a save."""
+    try:
+        from faster_distributed_training_tpu.telemetry.programs import (
+            leaf_tier)
+        tiers: dict = {}
+        for leaf in jax.tree.leaves(state.opt_state):
+            t = leaf_tier(leaf)
+            tiers[t] = tiers.get(t, 0) + 1
+        if not (tiers.get("sharded") or tiers.get("offloaded")):
+            return {}
+        return tiers
+    except Exception:
+        return {}
+
+
 def save_checkpoint(checkpoint_dir: str, name: str, state: TrainState,
                     epoch: int, best_acc: float,
                     extra_meta: Optional[dict] = None) -> str:
@@ -126,9 +152,11 @@ def save_checkpoint(checkpoint_dir: str, name: str, state: TrainState,
     checkpointable attributes with HOST (numpy) leaves — the resilience
     manager's async path saves a device_get snapshot this way."""
     path = _ckpt_dir(checkpoint_dir, name)
+    layout = opt_state_layout(state)
     return save_pytree_checkpoint(
         path, _state_pytree(state),
         {"epoch": int(epoch), "best_acc": float(best_acc),
+         **({"opt_state_layout": layout} if layout else {}),
          **(extra_meta or {})})
 
 
@@ -189,6 +217,13 @@ def restore_checkpoint(checkpoint_dir: str, name: str, state: TrainState
             restored = _restore_legacy(path, template, structural,
                                        raw=raw)
     meta = read_checkpoint_meta(checkpoint_dir, name)
+    saved_layout = meta.get("opt_state_layout")
+    live_layout = opt_state_layout(state)
+    if saved_layout and live_layout and saved_layout != live_layout:
+        print(f"[ckpt] opt-state layout changed across restore: "
+              f"checkpoint was written with {saved_layout}, restoring "
+              f"into {live_layout} — values re-placed by the template "
+              f"shardings (ZeRO<->replicated interchange)")
     epoch = int(meta.get("epoch", 0))
     best_acc = float(meta.get("best_acc", 0.0))
     state = state.replace(

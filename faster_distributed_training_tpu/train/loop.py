@@ -332,7 +332,19 @@ class Trainer:
         self._sharding_expect = programs.sharding_fingerprint(state)
         self._sharding_detail = (programs.sharding_table(state)
                                  if self.cfg.debug else None)
+        tiers = programs.state_bytes_table(state).get(
+            "opt_state_tiers") or {}
+        if set(tiers) - {"replicated"}:
+            # the ZeRO layout is live: say where the opt-state bytes
+            # went (sharded over tp / parked in pinned host memory)
+            self.log("[memory] opt state per chip: " + ", ".join(
+                f"{t}={v['bytes_per_chip'] / 1e6:.1f}MB"
+                f"/{v['leaves']} leaves"
+                for t, v in sorted(tiers.items())))
         if self.telemetry is not None:
+            # the splat must stay a DIRECT state_bytes_table call —
+            # scripts/check_telemetry_schema.py resolves its field
+            # vocabulary through _SPLAT_SOURCES by callable name
             self.telemetry.recorder.record_event(
                 "memory", **programs.state_bytes_table(state))
 

@@ -86,8 +86,17 @@ def _offload_transfers(state_shardings):
     them."""
     if state_shardings is None:
         return (lambda s: s), (lambda s: s)
-    to_dev = jax.tree.map(lambda sh: sh.with_memory_kind("device"),
-                          state_shardings)
+
+    def device_kind(sh):
+        # only host-pinned leaves transfer; the rest keep their sharding
+        # (the partial --offload_opt_state tier, and backends like CPU
+        # whose only memory kind IS the host) — device_put on an
+        # unchanged sharding is a cheap placement pin
+        if getattr(sh, "memory_kind", None) == "pinned_host":
+            return sh.with_memory_kind("device")
+        return sh
+
+    to_dev = jax.tree.map(device_kind, state_shardings)
 
     def fetch(state):
         return jax.tree.map(jax.device_put, state, to_dev)
@@ -130,15 +139,44 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
     # free to replicate the optimizer update's outputs, silently undoing
     # the 1/tp per-param footprint the sharding exists for.  Offload
     # runs pin through stash() instead (different memory kinds).
-    constrain_out = state_shardings is not None and not cfg.host_offload
-    if cfg.host_offload and state_shardings is None:
+    offload = cfg.host_offload or getattr(cfg, "offload_opt_state", False)
+    if offload and state_shardings is None:
         # the placement layer pins params/opt state to pinned_host for this
         # cfg; a step without the fetch would compile against host-placed
         # operands (TPU: compile error; worse, a silent contract violation)
-        raise ValueError("cfg.host_offload=True requires state_shardings "
-                         "(see parallel.placement.train_state_shardings)")
+        raise ValueError("cfg.host_offload/offload_opt_state requires "
+                         "state_shardings (see parallel.placement."
+                         "train_state_shardings)")
+    if offload and not any(
+            getattr(s, "memory_kind", None) == "pinned_host"
+            for s in jax.tree.leaves(
+                state_shardings, is_leaf=lambda x: hasattr(x, "mesh"))):
+        # backend without a pinned_host tier (CPU): the placement layer
+        # already degraded every pin to plain device sharding, so the
+        # fetch/stash round-trip would be pure no-op plumbing — but
+        # flipping constrain_out still changes GSPMD's partitioning and
+        # with it fp32 reduction order.  Treat the flag as fully off so
+        # --offload_opt_state on a host-only backend is BITWISE inert
+        # (pinned by test_offload_opt_state_degrades_bitwise_on_cpu).
+        offload = False
+    constrain_out = state_shardings is not None and not offload
     fetch, stash = _offload_transfers(
-        state_shardings if cfg.host_offload else None)
+        state_shardings if offload else None)
+    # --overlap_grad_reduce: reshard grads through byte-bounded 1-D
+    # buckets constrained to the zero axis, so GSPMD lowers the gradient
+    # psum as bucketed reduce-scatter it can overlap with the next
+    # microbatch's compute inside the K-dispatch scan.  Value-identity.
+    reduce_grads = lambda g: g                                 # noqa: E731
+    if getattr(cfg, "overlap_grad_reduce", False) \
+            and state_shardings is not None:
+        from faster_distributed_training_tpu.parallel.sharding import (
+            bucketed_grad_reduce)
+        _mesh = jax.tree.leaves(
+            state_shardings,
+            is_leaf=lambda x: hasattr(x, "mesh"))[0].mesh
+        _bucket = int(getattr(cfg, "overlap_bucket_mb", 4)) << 20
+        reduce_grads = lambda g: bucketed_grad_reduce(      # noqa: E731
+            g, _mesh, bucket_bytes=_bucket)
     # the augmentation stream root — the same seed+1 derivation
     # cli.run_training used for the host-counter stream it replaces
     aug_root = jax.random.PRNGKey(cfg.seed + 1)
@@ -200,6 +238,7 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
 
             grads, (loss, loss_total, correct, total, new_stats) = jax.grad(
                 loss_fn, has_aux=True)(state.params)
+            grads = reduce_grads(grads)
             grads, finite = unscale_and_check(grads, state.loss_scale, fp16)
             updated = state.apply_gradients(grads).replace(
                 batch_stats=new_stats,
@@ -272,6 +311,7 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
 
         grads, (loss, logits, y_a, y_b, lam, new_stats) = jax.grad(
             loss_fn, has_aux=True)(state.params)
+        grads = reduce_grads(grads)
         grads, finite = unscale_and_check(grads, state.loss_scale, fp16)
 
         updated = state.apply_gradients(grads).replace(

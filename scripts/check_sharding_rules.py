@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""ZeRO opt-state sharding-rule coverage lint (ISSUE 16 satellite; the
+check_kernel_routing.py idiom applied to the sharding registries).
+
+parallel/sharding.py keeps the ZeRO layout in TWO inspectable tables:
+``OPT_STATE_RULES`` (how a leaf class gets sharded) and
+``REPLICATED_OPT_STATE`` (leaf classes that stay replicated WITH the
+committed reason).  The failure mode this lint closes: a new optimizer
+(or a new slot in an existing one) produces a leaf no rule recognizes,
+``classify_opt_state_leaf`` quietly replicates it, and the per-chip
+HBM win silently erodes.  Enforced (tests/test_zero_sharding.py):
+
+  1. every opt-state leaf of every REGISTERED optimizer tree (ngd
+     grouped + ungrouped, sgd, madgrad, mirror_madgrad, adamw — built
+     live via optim.builder/optim.ngd against probe param trees) must
+     classify into a rule or an explicit replicate-with-reason class —
+     the catch-all "unmatched" class FAILS;
+  2. every registry entry except "unmatched" must be exercised by at
+     least one probe leaf (the registry cannot rot into fiction);
+  3. the two registries must be disjoint (one name, one story).
+
+Run:  python scripts/check_sharding_rules.py   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Set, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+# the zero-axis size the probes classify against; 2 is the smallest
+# real tp degree and what the tier-1 meshes use
+PROBE_AXIS_SIZE = 2
+
+
+def _probe_params():
+    """Two param trees that between them exercise every leaf class:
+    a transformer-ish tree (big divisible kernels, sub-floor biases)
+    and an awkward one whose big kernel has NO axis divisible by the
+    probe size (the 'indivisible' replicate class)."""
+    import jax.numpy as jnp
+
+    main = {"model": {
+        "fc": {"kernel": jnp.zeros((512, 100)), "bias": jnp.zeros((100,))},
+        "emb": {"kernel": jnp.zeros((1000, 64))},
+        "ln": {"scale": jnp.ones((64,))},
+    }}
+    odd = {"model": {"odd": {"kernel": jnp.zeros((1025, 7))}}}
+    return main, odd
+
+
+def _probe_opt_states():
+    """(label, params, opt_state) for every optimizer family the repo
+    registers (optim/builder.py names) plus NGD's ungrouped mode."""
+    import optax
+
+    from faster_distributed_training_tpu.optim.madgrad import (
+        madgrad, mirror_madgrad)
+    from faster_distributed_training_tpu.optim.ngd import ngd, scale_by_ngd
+
+    main, odd = _probe_params()
+    txs = [
+        ("ngd", ngd(0.1, momentum=0.9, weight_decay=1e-4, use_ngd=True)),
+        ("ngd_ungrouped", scale_by_ngd(grouped=False)),
+        ("sgd", ngd(0.1, momentum=0.9, weight_decay=1e-4, use_ngd=False)),
+        ("madgrad", madgrad(0.1)),
+        ("mirror_madgrad", mirror_madgrad(0.1)),
+        ("adamw", optax.adamw(1e-3)),
+    ]
+    out = []
+    for label, tx in txs:
+        out.append((label, main, tx.init(main)))
+    # the indivisible probe only needs one param-mirroring optimizer
+    out.append(("sgd_indivisible", odd,
+                ngd(0.1, momentum=0.9, use_ngd=False).init(odd)))
+    return out
+
+
+def classify_all(n: int = PROBE_AXIS_SIZE
+                 ) -> List[Tuple[str, str, tuple, str]]:
+    """(optimizer label, leaf keystr, shape, classified name) for every
+    probe opt-state leaf."""
+    import jax
+    import numpy as np
+
+    from faster_distributed_training_tpu.parallel.sharding import (
+        _param_suffix_table, classify_opt_state_leaf)
+    from jax.sharding import PartitionSpec as P
+
+    rows = []
+    for label, params, opt in _probe_opt_states():
+        pspecs = jax.tree.map(lambda _: P(), params)
+        suffixes = _param_suffix_table(params, pspecs)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(opt)[0]:
+            key = jax.tree_util.keystr(path)
+            name, _ = classify_opt_state_leaf(
+                key, np.shape(leaf), suffixes, n)
+            rows.append((label, key, tuple(np.shape(leaf)), name))
+    return rows
+
+
+def check(n: int = PROBE_AXIS_SIZE) -> List[str]:
+    """All rule-coverage problems found, [] when clean."""
+    from faster_distributed_training_tpu.parallel.sharding import (
+        OPT_STATE_RULES, REPLICATED_OPT_STATE)
+
+    problems: List[str] = []
+
+    overlap = set(OPT_STATE_RULES) & set(REPLICATED_OPT_STATE)
+    for name in sorted(overlap):
+        problems.append(
+            f"rule 3: {name!r} appears in BOTH OPT_STATE_RULES and "
+            f"REPLICATED_OPT_STATE — one name, one story")
+
+    known: Set[str] = set(OPT_STATE_RULES) | set(REPLICATED_OPT_STATE)
+    hit: Dict[str, int] = {}
+    for label, key, shape, name in classify_all(n):
+        hit[name] = hit.get(name, 0) + 1
+        if name == "unmatched":
+            problems.append(
+                f"rule 1: {label} leaf {key} {shape} classified "
+                f"'unmatched' — register a sharding rule in sharding."
+                f"OPT_STATE_RULES (or an explicit replicate-with-reason "
+                f"entry in REPLICATED_OPT_STATE) for this leaf class")
+        elif name not in known:
+            problems.append(
+                f"rule 1: {label} leaf {key} {shape} classified into "
+                f"unregistered class {name!r} — classify_opt_state_leaf "
+                f"and the registries drifted apart")
+
+    for name in sorted(known - {"unmatched"}):
+        if not hit.get(name):
+            problems.append(
+                f"rule 2: registry entry {name!r} is exercised by no "
+                f"probe opt-state leaf — the registry rotted (or the "
+                f"probe trees in scripts/check_sharding_rules.py need a "
+                f"new case)")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"[sharding-rules] {p}")
+    if problems:
+        print(f"[sharding-rules] {len(problems)} violation(s)")
+        return 1
+    print("[sharding-rules] clean: every opt-state leaf class of every "
+          "registered optimizer matches a sharding rule or a documented "
+          "replicate-with-reason entry")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -329,6 +329,9 @@ class TestFusedFFNSublayer:
                      - np.asarray(jax.lax.erf(x)))
         assert float(err.max()) < 1e-6
 
+    @pytest.mark.slow  # r20 budget diet: 28 s — sharded-vs-unsharded
+    # kernel parity incl. dropout placement-invariance is tier-1 in
+    # tests/test_kernel_shard.py (the r19 layer this wrapper predates)
     def test_sharded_wrapper_matches_unsharded(self, devices8):
         """fused_ffn_sublayer_sharded is PLACEMENT-INVARIANT (the
         codebase's sharded-dropout convention, ops/attention.py

@@ -1438,6 +1438,10 @@ def test_pod_restart_smoke(monkeypatch):
     assert mod.main(ref_digest=_smoke_reference_digest(mod)) == 0
 
 
+@pytest.mark.slow  # r20 budget diet: 29 s — the SAME smoke as
+# test_pod_restart_smoke (which stays tier-1) on the fake-object-store
+# backend; the backend's rename-free semantics are unit-tested in
+# test_resilience.py
 def test_pod_restart_smoke_fake_object_store(monkeypatch):
     """r14 satellite: the SAME two-process kill/recover scenario with
     every resilience-critical durable write on the rename-free

@@ -584,6 +584,10 @@ class TestStreamTrainingE2E:
 
 # -- the process-level smoke, in-process (tier-1 acceptance) --------------
 
+@pytest.mark.slow  # r20 budget diet: 26 s — the shard→stream→kill→
+# resume contract stays tier-1 via TestStreamTrainingE2E (in-process
+# streamed_ref fixtures incl. test_killed_mid_window_resumes_bitwise);
+# this adds only the fresh-subprocess framing
 def test_stream_smoke_in_process(monkeypatch):
     """scripts/stream_smoke.py end-to-end: shard → streamed reference →
     kill mid-window → FRESH-PROCESS resume → digest equality.  Env

@@ -367,6 +367,9 @@ class TestEndToEnd:
         assert sum(1 for r in recs
                    if r["kind"] == "step" and r.get("compile")) == 1
 
+    @pytest.mark.slow  # r20 budget diet: 38 s — operator tooling, not
+    # a correctness contract; the window boundary arithmetic stays
+    # tier-1 via the profile-window unit tests above
     def test_profile_steps_window_produces_trace(self, tmp_path):
         """--profile_steps A:B produces a trace directory covering only
         the requested window (start/stop observed via the log; the real

@@ -546,6 +546,9 @@ class TestFailureRecovery:
 
 
 class TestShardedCheckpoint:
+    @pytest.mark.slow  # r20 budget diet: 25 s — sharded checkpoint
+    # roundtrips stay tier-1 via test_mesh2d.py (tp two-phase) and
+    # test_zero_sharding.py (ZeRO↔replicated interchange, both paths)
     def test_fsdp_sharded_roundtrip(self, devices8, tmp_path):
         """Save from a ZeRO-3-sharded state and restore into a fresh sharded
         template: values identical, shardings preserved (the multi-host

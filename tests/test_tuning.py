@@ -26,6 +26,9 @@ class TestGridParse:
 
 
 class TestSweep:
+    @pytest.mark.slow  # r20 budget diet: 64 s — heaviest tier-1 test;
+    # the sweep JSON aggregation contract stays tier-1 via
+    # test_int_fields_stay_int, the trial machinery via TestVmapTrials
     def test_two_trial_sweep_aggregates_json(self, tmp_path):
         base = TrainConfig(model="resnet18", dataset="synthetic",
                            num_classes=10, batch_size=32, epochs=1,

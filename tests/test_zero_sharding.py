@@ -1,0 +1,351 @@
+"""ZeRO optimizer-state sharding (ISSUE 16 tentpole) pins.
+
+What is pinned here:
+  * the shape-aware rule classes (sharding.OPT_STATE_RULES /
+    REPLICATED_OPT_STATE) and the coverage lint
+    (scripts/check_sharding_rules.py) that keeps them honest;
+  * the dp4xtp2 ZeRO twin: losses allclose to the replicated-opt-state
+    run AND the >= 1.8x opt_state_bytes_per_chip drop the ISSUE's
+    acceptance criterion names;
+  * checkpoint INTERCHANGE: a ZeRO-sharded run's checkpoint restores
+    bitwise into a replicated-opt-state config and vice versa, through
+    BOTH the single-file orbax path and the r9 two-phase sharded path;
+  * the sharding-drift guard fires when a sharded opt-state leaf is
+    deliberately re-replicated;
+  * --offload_opt_state degrades cleanly (no pinned_host on CPU) and
+    the step stream stays bitwise vs the non-offload run;
+  * --overlap_grad_reduce is value-preserving (allclose twin).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from faster_distributed_training_tpu.config import TrainConfig
+from faster_distributed_training_tpu.optim.builder import build_optimizer
+from faster_distributed_training_tpu.parallel.placement import (
+    make_put_batch, shard_train_state, train_state_shardings)
+from faster_distributed_training_tpu.parallel.sharding import (
+    OPT_STATE_RULES, REPLICATED_OPT_STATE, bucketed_grad_reduce,
+    classify_opt_state_leaf, _param_suffix_table)
+from faster_distributed_training_tpu.train import checkpoint as ckpt
+from faster_distributed_training_tpu.train.state import create_train_state
+from faster_distributed_training_tpu.train.steps import make_train_step
+
+
+def _tree_equal(a, b) -> bool:
+    a = jax.device_get(a)
+    b = jax.device_get(b)
+    return all(jax.tree.leaves(
+        jax.tree.map(lambda x, y: bool(np.array_equal(np.asarray(x),
+                                                      np.asarray(y))),
+                     a, b)))
+
+
+def _cfg(**kw) -> TrainConfig:
+    base = dict(model="transformer", dataset="synthetic", batch_size=8,
+                seq_len=16, n_layers=1, d_model=16, d_ff=32, n_heads=2,
+                optimizer="sgd", use_ngd=False, precision="fp32",
+                donate=False, alpha=0.0, telemetry=False, plot=False)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _build(devices, mesh_shape, axes, cfg, n_steps=3):
+    """(state, losses, shardings, cfg) after n_steps on a fixed batch."""
+    from faster_distributed_training_tpu.cli import build_model
+
+    devs = np.array(devices[:int(np.prod(mesh_shape))]).reshape(mesh_shape)
+    mesh = Mesh(devs, axes)
+    cfg = cfg.replace(mesh_axes=axes)
+    model = build_model(cfg, vocab_size=128, mesh=mesh)
+    tx, _ = build_optimizer(cfg, steps_per_epoch=10)
+    sample = jnp.zeros((cfg.batch_size, cfg.seq_len), jnp.int32)
+    state = create_train_state(model, tx, sample, jax.random.PRNGKey(0),
+                               init_kwargs={"train": True})
+    shardings = (train_state_shardings(state, mesh, cfg)
+                 if len(axes) > 1 or cfg.offload_opt_state
+                 or cfg.overlap_grad_reduce else None)
+    state = shard_train_state(state, mesh, cfg, shardings=shardings)
+    step = jax.jit(make_train_step(cfg, shardings))
+    tok = np.random.RandomState(1).randint(
+        0, 100, (cfg.batch_size, cfg.seq_len)).astype(np.int32)
+    y = np.random.RandomState(2).randint(
+        0, 4, (cfg.batch_size,)).astype(np.int32)
+    batch = make_put_batch(mesh)({"tokens": tok, "label": y})
+    losses = []
+    for _ in range(n_steps):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    return state, losses, shardings, cfg
+
+
+@pytest.fixture(scope="module")
+def zero_twin(devices8):
+    """One replicated 1D run and one dp4xtp2 ZeRO run, same model/data —
+    shared by the twin, byte-drop, and interchange tests."""
+    st1, l1, _, cfg1 = _build(devices8, (8,), ("dp",), _cfg())
+    st2, l2, sh2, cfg2 = _build(devices8, (4, 2), ("dp", "tp"), _cfg())
+    return {"repl": (st1, l1, cfg1), "zero": (st2, l2, sh2, cfg2)}
+
+
+class TestRules:
+    def test_registries_disjoint_and_documented(self):
+        assert not set(OPT_STATE_RULES) & set(REPLICATED_OPT_STATE)
+        for reason in list(OPT_STATE_RULES.values()) + \
+                list(REPLICATED_OPT_STATE.values()):
+            assert len(reason) > 20     # a story, not a stub
+
+    def test_classify_by_role_and_shape(self):
+        params = {"model": {"fc": {"kernel": jnp.zeros((512, 100)),
+                                   "bias": jnp.zeros((100,))}}}
+        suf = _param_suffix_table(params, jax.tree.map(lambda _: P(),
+                                                       params))
+        # mirror: endswith + shape
+        name, spec = classify_opt_state_leaf(
+            "[1].trace['model']['fc']['kernel']", (512, 100), suf, 2)
+        assert name == "param_mirror" and spec == P("tp", None)
+        # mirror inherits the param's tp spec when it has one
+        suf2 = {"['model']['fc']['kernel']": ((512, 100), P(None, "tp"))}
+        name, spec = classify_opt_state_leaf(
+            "[1].trace['model']['fc']['kernel']", (512, 100), suf2, 2)
+        assert name == "param_mirror" and spec == P(None, "tp")
+        # NGD grouped factor: leading G axis when divisible
+        name, spec = classify_opt_state_leaf(
+            "[1].groups['r2:n576:d64:k32'].w", (2, 32, 64), suf, 2)
+        assert name == "ngd_group_factor" and spec == P("tp", None, None)
+        # ... falls back to any divisible axis when G is not
+        name, spec = classify_opt_state_leaf(
+            "[1].groups['r0:n100:d512:k80'].w", (1, 80, 512), suf, 2)
+        assert name == "ngd_group_factor" and spec == P(None, None, "tp")
+        # scalars / small / indivisible replicate with a reason
+        assert classify_opt_state_leaf("[1].t", (), suf, 2) == \
+            ("scalar", P())
+        assert classify_opt_state_leaf(
+            "[1].trace['model']['fc']['bias']", (100,), suf, 2) == \
+            ("small", P())
+        name, spec = classify_opt_state_leaf(
+            "[0].mu['model']['odd']", (1025, 7),
+            {"['model']['odd']": ((1025, 7), P())}, 2)
+        assert (name, spec) == ("indivisible", P())
+        # an unknown role stays replicated but is named 'unmatched'
+        # (the lint turns that into a failure)
+        name, spec = classify_opt_state_leaf(
+            "[0].mystery_slot", (4096, 4096), {}, 2)
+        assert (name, spec) == ("unmatched", P())
+
+    def test_coverage_lint_clean_and_catches_unmatched(self):
+        from scripts import check_sharding_rules as lint
+        assert lint.check() == []
+        # a foreign optimizer slot must FAIL the lint, not silently
+        # replicate: simulate by classifying a leaf no rule knows
+        rows = [("fake_opt", ".exotic_slot['model']", (2048, 2048),
+                 "unmatched")]
+        orig = lint.classify_all
+        lint.classify_all = lambda n=2: rows
+        try:
+            problems = lint.check()
+        finally:
+            lint.classify_all = orig
+        assert any("unmatched" in p for p in problems)
+        # and rule 2 fires too (no probe hit the real registries)
+        assert any("rule 2" in p for p in problems)
+
+
+class TestZeroTwin:
+    def test_losses_allclose_to_replicated(self, zero_twin):
+        _, l1, _ = zero_twin["repl"]
+        _, l2, _, _ = zero_twin["zero"]
+        assert np.allclose(l1, l2, rtol=2e-4), (l1, l2)
+
+    def test_opt_state_bytes_drop_and_tiers(self, zero_twin):
+        from faster_distributed_training_tpu.telemetry.programs import (
+            state_bytes_table)
+        st1, _, _ = zero_twin["repl"]
+        st2, _, _, _ = zero_twin["zero"]
+        t1 = state_bytes_table(st1)
+        t2 = state_bytes_table(st2)
+        ratio = t1["opt_state_bytes_per_chip"] / t2["opt_state_bytes_per_chip"]
+        # the ISSUE acceptance: >= 1.8x drop on a tp=2 mesh
+        assert ratio >= 1.8, (t1["opt_state_bytes_per_chip"],
+                              t2["opt_state_bytes_per_chip"])
+        tiers = t2["opt_state_tiers"]
+        assert tiers["sharded"]["bytes_per_chip"] > \
+            tiers["replicated"]["bytes_per_chip"]
+        # per-leaf attribution reaches top_leaves too
+        assert all("tier" in leaf for leaf in t2["top_leaves"])
+
+    def test_momentum_actually_sharded(self, zero_twin):
+        st2, _, _, _ = zero_twin["zero"]
+        flat = jax.tree_util.tree_flatten_with_path(st2.opt_state)[0]
+        sharded = {jax.tree_util.keystr(p): v.sharding.spec
+                   for p, v in flat
+                   if not v.sharding.is_fully_replicated}
+        # the qkv momentum follows its param's tp spec
+        assert any("qkv" in k and "kernel" in k for k in sharded), sharded
+        for key, spec in sharded.items():
+            assert "tp" in jax.tree.leaves(tuple(spec)), (key, spec)
+
+    def test_no_zero_opt_restores_replicated_layout(self, devices8):
+        st, _, _, _ = _build(devices8, (4, 2), ("dp", "tp"),
+                             _cfg(zero_opt=False), n_steps=1)
+        for leaf in jax.tree.leaves(st.opt_state):
+            assert leaf.sharding.is_fully_replicated
+
+
+class TestCheckpointInterchange:
+    """A checkpoint is layout-free: ZeRO-sharded <-> replicated configs
+    restore each other bitwise through both checkpoint formats."""
+
+    def _roundtrip_single_file(self, tmp_path, src_state, dst_state):
+        ckpt.save_checkpoint(str(tmp_path), "x", src_state, epoch=1,
+                             best_acc=0.5)
+        restored, epoch, acc = ckpt.restore_checkpoint(
+            str(tmp_path), "x", dst_state)
+        assert (epoch, acc) == (1, 0.5)
+        return restored
+
+    def _roundtrip_sharded(self, tmp_path, src_state, dst_state):
+        blocks = ckpt.host_shard_snapshot(src_state)
+        ckpt.write_host_shards(str(tmp_path / "s"), 0, blocks)
+        ckpt.commit_sharded_checkpoint(str(tmp_path / "s"),
+                                       {"epoch": 1, "best_acc": 0.5},
+                                       n_hosts=1)
+        restored, epoch, acc = ckpt.restore_sharded_checkpoint(
+            str(tmp_path), "s", dst_state)
+        assert (epoch, acc) == (1, 0.5)
+        return restored
+
+    @pytest.mark.parametrize("path", ["single", "sharded"])
+    def test_zero_to_replicated_bitwise(self, tmp_path, zero_twin, path):
+        st_zero = zero_twin["zero"][0]
+        # fresh replicated-config template (same arch, same abstract tree)
+        dst, _, _, _ = _build(jax.devices()[:8], (8,), ("dp",), _cfg(),
+                              n_steps=0)
+        rt = (self._roundtrip_single_file if path == "single"
+              else self._roundtrip_sharded)
+        restored = rt(tmp_path, st_zero, dst)
+        assert _tree_equal(ckpt._state_pytree(restored),
+                           ckpt._state_pytree(st_zero))
+
+    @pytest.mark.parametrize("path", ["single", "sharded"])
+    def test_replicated_to_zero_bitwise(self, tmp_path, zero_twin, path):
+        st_repl = zero_twin["repl"][0]
+        dst, _, sh, _ = _build(jax.devices()[:8], (4, 2), ("dp", "tp"),
+                               _cfg(), n_steps=0)
+        rt = (self._roundtrip_single_file if path == "single"
+              else self._roundtrip_sharded)
+        restored = rt(tmp_path, st_repl, dst)
+        assert _tree_equal(ckpt._state_pytree(restored),
+                           ckpt._state_pytree(st_repl))
+        # re-placing onto the ZeRO shardings preserves values exactly
+        from faster_distributed_training_tpu.parallel.placement import (
+            place_on_shardings)
+        placed = place_on_shardings(restored, sh)
+        assert _tree_equal(ckpt._state_pytree(placed),
+                           ckpt._state_pytree(st_repl))
+
+    def test_meta_records_opt_state_layout(self, tmp_path, zero_twin):
+        # the save meta pins which ZeRO layout wrote the checkpoint:
+        # sharded leaves present under ZeRO, absent on the 1D replicated
+        # twin's layout summary
+        st_zero = zero_twin["zero"][0]
+        ckpt.save_checkpoint(str(tmp_path), "z", st_zero, epoch=0,
+                             best_acc=0.0)
+        meta = ckpt.read_checkpoint_meta(str(tmp_path), "z")
+        layout = meta.get("opt_state_layout")
+        assert layout and layout.get("sharded", 0) > 0
+        st_repl = zero_twin["repl"][0]
+        assert ckpt.opt_state_layout(st_repl).get("sharded", 0) == 0
+
+
+class TestDriftGuard:
+    def test_rereplicating_sharded_opt_leaf_warns(self, zero_twin):
+        from faster_distributed_training_tpu.train.loop import Trainer
+        st, _, sh, cfg = zero_twin["zero"]
+        tr = Trainer.__new__(Trainer)
+        tr.cfg = cfg.replace(debug=True)
+        tr.telemetry = None
+        tr.log = lambda *_: None
+        tr._sharding_expect = None
+        tr._sharding_detail = None
+        tr._observe_state_placement(st)
+        assert tr._sharding_expect is not None
+
+        # deliberately re-replicate every sharded opt-state leaf (the
+        # r11 drift class applied to the ZeRO layout)
+        mesh = jax.tree.leaves(
+            sh, is_leaf=lambda x: hasattr(x, "mesh"))[0].mesh
+        repl = NamedSharding(mesh, P())
+        drifted = st.replace(opt_state=jax.tree.map(
+            lambda x: jax.device_put(x, repl), st.opt_state))
+        with pytest.warns(UserWarning, match="sharding DRIFT"):
+            tr._check_sharding_drift(drifted, epoch=1)
+        # the guard re-anchors: a second check on the same state is quiet
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            tr._check_sharding_drift(drifted, epoch=2)
+
+
+class TestOffloadAndOverlap:
+    def test_offload_selection_by_size(self):
+        from faster_distributed_training_tpu.parallel.sharding import (
+            OFFLOAD_MIN_ELEMENTS, offload_opt_leaf)
+        assert offload_opt_leaf((OFFLOAD_MIN_ELEMENTS,))
+        assert offload_opt_leaf((512, 512))
+        assert not offload_opt_leaf((100,))
+        assert not offload_opt_leaf(())
+
+    def test_leaf_tier_attribution(self):
+        from faster_distributed_training_tpu.telemetry.programs import (
+            leaf_tier)
+
+        class FakeSharding:
+            memory_kind = "pinned_host"
+            is_fully_replicated = False
+
+        class FakeLeaf:
+            sharding = FakeSharding()
+
+        assert leaf_tier(FakeLeaf()) == "offloaded"
+        assert leaf_tier(np.zeros(3)) == "host"
+        x = jnp.zeros((4,))
+        assert leaf_tier(x) == "replicated"
+
+    def test_offload_opt_state_degrades_bitwise_on_cpu(self, devices8):
+        # no pinned_host on the CPU backend: the tier degrades to plain
+        # device pins — the step stream must be bitwise vs offload-off
+        st_off, l_off, _, _ = _build(devices8, (4, 2), ("dp", "tp"),
+                                     _cfg(offload_opt_state=True))
+        st_ref, l_ref, _, _ = _build(devices8, (4, 2), ("dp", "tp"),
+                                     _cfg())
+        assert l_off == l_ref
+        assert _tree_equal(ckpt._state_pytree(st_off),
+                           ckpt._state_pytree(st_ref))
+
+    def test_offload_requires_shardings(self):
+        with pytest.raises(ValueError, match="offload_opt_state"):
+            make_train_step(_cfg(offload_opt_state=True), None)
+
+    def test_bucketed_grad_reduce_identity(self, devices8):
+        devs = np.array(devices8).reshape(4, 2)
+        mesh = Mesh(devs, ("dp", "tp"))
+        grads = {"a": jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                 "b": jnp.ones((7,), jnp.float32) * 3,   # pad path
+                 "c": jnp.asarray(2.5),                  # scalar
+                 "d": jnp.arange(10, dtype=jnp.int32)}   # second dtype
+        out = jax.jit(lambda g: bucketed_grad_reduce(
+            g, mesh, bucket_bytes=64))(grads)
+        for k in grads:
+            np.testing.assert_array_equal(np.asarray(out[k]),
+                                          np.asarray(grads[k]))
+
+    def test_overlap_twin_allclose(self, devices8, zero_twin):
+        _, l_ref, _, _ = zero_twin["zero"]
+        _, l_on, _, _ = _build(devices8, (4, 2), ("dp", "tp"),
+                               _cfg(overlap_grad_reduce=True))
+        assert np.allclose(l_ref, l_on, rtol=1e-4), (l_ref, l_on)
