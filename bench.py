@@ -1109,6 +1109,141 @@ def timed_serve(mix: str) -> dict:
             "qps": out["qps"], "qps_per_chip": out["qps_per_chip"]}
 
 
+# Decode-serving arms (r21 serve/decode tentpole): one tiny LM
+# checkpoint per child, the REAL autoregressive stack on it — paged KV
+# cache, AOT prefill + per-page-count decode-step program families,
+# token-granular continuous batching.  Two arms: decode_gen (closed
+# loop — submit everything, measure TTFT percentiles + sustained decode
+# throughput per chip) and decode_sustained (open loop — submissions
+# PACED at a target QPS so queueing delay surfaces as SLO violations;
+# a closed loop self-throttles and can never show an under-provisioned
+# decode tier failing).
+DECODE_BENCH_SEQ = 16
+
+
+def _decode_bench_cfg(d):
+    """The decode arms' tiny-LM config: stream-corpus next-token
+    training at seq 16 with (8, 16) buckets, then single-replica greedy
+    decoding at 4 slots over 4-token pages.  Tiny by design — the arms
+    measure the prefill/step/admission machinery's fixed cost, not the
+    model."""
+    from faster_distributed_training_tpu.config import TrainConfig
+    return TrainConfig(model="transformer", dataset="stream", task="lm",
+                       data_path="stream",
+                       stream_dir=os.path.join(d, "stream"),
+                       batch_size=8, seq_len=DECODE_BENCH_SEQ,
+                       n_layers=1, d_model=16, d_ff=32, n_heads=2,
+                       epochs=1, steps_per_dispatch=2, stream_window=4,
+                       optimizer="sgd", precision="fp32", plot=False,
+                       workers=0, log_every=0, donate=False,
+                       checkpoint_dir=os.path.join(d, "ckpt"),
+                       seq_buckets=(8, 16), decode_batch_size=4,
+                       decode_page=4, decode_replicas=1,
+                       decode_max_new_tokens=8, telemetry=False)
+
+
+def _decode_train_ckpt(cfg):
+    from faster_distributed_training_tpu.cli import run_training
+    from faster_distributed_training_tpu.data.stream import (
+        synthetic_corpus, write_lm_corpus)
+    texts = synthetic_corpus(40, seed=3, words_per_doc=(25, 50))
+    write_lm_corpus(cfg.stream_dir, texts, seq_len=DECODE_BENCH_SEQ,
+                    rows_per_shard=16, val_fraction=0.15)
+    run_training(cfg, log=lambda *_: None)
+
+
+def timed_decode_gen() -> dict:
+    """Closed-loop decode arm: train the tiny LM, push
+    FDT_BENCH_DECODE_REQUESTS ragged prompts through
+    cli.run_decode_serving, report TTFT percentiles + generated tokens
+    per second per chip — the decode tier's headline throughput."""
+    import shutil
+    import tempfile
+
+    from faster_distributed_training_tpu.cli import run_decode_serving
+
+    n_req = int(os.environ.get("FDT_BENCH_DECODE_REQUESTS", "24"))
+    d = tempfile.mkdtemp(prefix="fdt_bench_decode_")
+    try:
+        cfg = _decode_bench_cfg(d)
+        _decode_train_ckpt(cfg)
+        out = run_decode_serving(cfg.replace(decode_requests=n_req),
+                                 log=lambda *_: None)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return {"requests": out["requests"], "tokens": out["tokens"],
+            "steps": out["steps"], "prefills": out["prefills"],
+            "ttft_p50_ms": out["ttft_p50_ms"],
+            "ttft_p99_ms": out["ttft_p99_ms"],
+            "tokens_per_sec_per_chip": out["tokens_per_sec_per_chip"]}
+
+
+def timed_decode_sustained() -> dict:
+    """Open-loop decode arm: same tiny LM, single decode replica, but
+    submissions arrive PACED at FDT_BENCH_DECODE_QPS regardless of
+    completions — arrival-time load, not completion-time load.  A
+    request whose total latency exceeds FDT_BENCH_DECODE_SLO_MS counts
+    as an SLO violation; the violation percentage is the metric an
+    under-provisioned decode tier actually fails on."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    import numpy as _np
+
+    from faster_distributed_training_tpu.models.decode import SamplingCfg
+    from faster_distributed_training_tpu.serve import (RequestQueue,
+                                                       load_serving_state)
+    from faster_distributed_training_tpu.serve.decode import (
+        DecodeEngine, DecodeScheduler)
+
+    n_req = int(os.environ.get("FDT_BENCH_DECODE_REQUESTS", "24"))
+    qps = float(os.environ.get("FDT_BENCH_DECODE_QPS", "8"))
+    slo_ms = float(os.environ.get("FDT_BENCH_DECODE_SLO_MS", "2000"))
+    d = tempfile.mkdtemp(prefix="fdt_bench_decode_")
+    try:
+        cfg = _decode_bench_cfg(d)
+        _decode_train_ckpt(cfg)
+        model, sstate, meta = load_serving_state(cfg, log=lambda *_: None)
+        q = RequestQueue(cfg.seq_buckets, max_len=cfg.seq_len)
+        eng = DecodeEngine(model, sstate, q.buckets,
+                           batch_size=cfg.decode_batch_size,
+                           page=cfg.decode_page,
+                           sampling=SamplingCfg(seed=cfg.seed),
+                           name="decode0", log=lambda *_: None)
+        eng.warmup()
+        sched = DecodeScheduler(q, eng,
+                                max_new_tokens=cfg.decode_max_new_tokens,
+                                name="decode0", log=lambda *_: None)
+        sched.start()
+        rng = _np.random.default_rng(0)
+        vocab = int(meta.get("vocab") or 256)
+        prompts = [rng.integers(1, vocab, size=int(rng.integers(3, 13))
+                                ).astype(_np.int32) for _ in range(n_req)]
+        handles = []
+        t0 = _time.monotonic()
+        for i, p in enumerate(prompts):
+            # open loop: the i-th arrival is scheduled at t0 + i/qps no
+            # matter how far behind the decoder is running
+            lag = t0 + i / qps - _time.monotonic()
+            if lag > 0:
+                _time.sleep(lag)
+            handles.append(
+                q.submit(p, max_new_tokens=cfg.decode_max_new_tokens))
+        for h in handles:
+            h.wait(timeout=300.0)
+        q.close()
+        sched.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    lat = [h.latency_ms() for h in handles]
+    viol = sum(1 for t in lat if t is None or t > slo_ms)
+    return {"requests": len(handles), "target_qps": qps,
+            "slo_ms": slo_ms,
+            "slo_violation_pct": round(
+                100.0 * viol / max(len(lat), 1), 1)}
+
+
 def zero_opt_state_bytes(zero: bool) -> dict:
     """Per-chip state bytes of the ResNet-50/NGD train state on a
     dp x tp=2 mesh with the ZeRO opt-state overlay on or off — the
@@ -1489,7 +1624,8 @@ def _prev_bench_record():
 # NGD-overhead ratio; throughputs are stable to well under 5%).
 _HIGHER_IS_BETTER = ("value", "tricks_speedup", "ex_per_sec",
                      "img_per_sec", "achieved_tflops", "mfu_pct",
-                     "gemm_ceiling", "qps_per_chip")
+                     "gemm_ceiling", "qps_per_chip",
+                     "tokens_per_sec_per_chip")
 _LOWER_IS_BETTER = ("attn_fwdbwd_ms", "peak_mem_bytes", "step_ms",
                     "bytes_per_chip", "p50_ms", "p99_ms")
 _REL_THRESHOLD = {"attn_fwdbwd_ms": 0.25,   # ladder: >10% tunnel variance
@@ -1500,6 +1636,10 @@ _REL_THRESHOLD = {"attn_fwdbwd_ms": 0.25,   # ladder: >10% tunnel variance
                   #                           dominate; the qps arm is the
                   #                           tighter serving signal
                   "qps_per_chip": 0.35,
+                  # decode throughput shares the serving class: thread
+                  # scheduling + per-step dispatch noise on a shared CPU
+                  # host, tightened further by its measured noise band
+                  "tokens_per_sec_per_chip": 0.35,
                   "peak_mem_bytes": 0.02,   # compiled memory: deterministic
                   "bytes_per_chip": 0.02}   # state-byte attribution:
 #                                             deterministic (a move means
@@ -1519,7 +1659,14 @@ _ABS_PP_WORSE_IF_UP = {"ngd_overhead_pct": 1.5,
                        # blocked on the window refill at steady state —
                        # a +1pp move means the double-buffered H2D
                        # stopped hiding under compute
-                       "stream_stall_pct": 1.0}
+                       "stream_stall_pct": 1.0,
+                       # r21 decode tier: open-loop sustained load at
+                       # the target QPS must stay inside the SLO; a
+                       # +5pp move in the violation rate means the
+                       # decode loop lost real headroom (the wide
+                       # tolerance absorbs CPU-host scheduler jitter
+                       # on a ~24-request sample: one request = ~4pp)
+                       "decode_slo_violation_pct": 5.0}
 # -- guard-drift registry (r13 satellite; scripts/check_bench_arms.py) --
 # Every record key a bench arm can emit, as fnmatch patterns.  The lint
 # cross-checks this registry against (a) the *_step_ms string literals
@@ -1602,6 +1749,12 @@ PRODUCED_METRIC_PATTERNS = (
     # percentiles + sustained throughput per mix, ragged = headline
     "serve_*_p50_ms", "serve_*_p99_ms", "serve_*_qps_per_chip",
     "serve_p50_ms", "serve_p99_ms", "serve_qps_per_chip",
+    # r21 decode arms (serve/decode tentpole): closed-loop generation
+    # throughput + TTFT percentiles, and the open-loop sustained arm's
+    # SLO-violation rate at the target QPS (guard above)
+    "decode_tokens_per_sec_per_chip",
+    "decode_ttft_p50_ms", "decode_ttft_p99_ms",
+    "decode_slo_violation_pct",
 )
 # *_step_ms arms measured N-interleaved with a published noise band:
 NOISE_BANDED_STEP_MS = (
@@ -1939,6 +2092,16 @@ def main() -> None:
         # r16 serving arm: one batch/length request mix through the
         # serve/ stack (continuous batching + 2 AOT-warmed replicas)
         print(json.dumps(timed_serve(child[len("serve_"):])))
+        return
+    if child == "decode_gen":
+        # r21 decode arm: closed-loop generation through the paged-KV
+        # decode stack (TTFT percentiles + tokens/sec/chip)
+        print(json.dumps(timed_decode_gen()))
+        return
+    if child == "decode_sustained":
+        # r21 decode arm: open-loop sustained load at a target QPS —
+        # SLO-violation percentage under arrival-time pacing
+        print(json.dumps(timed_decode_sustained()))
         return
     if child.startswith("telem_"):
         # r12 observability arm: per-dispatch recorder on vs off, one
@@ -2399,6 +2562,52 @@ def main() -> None:
                 record["serve_p99_ms"] = record["serve_ragged_p99_ms"]
                 record["serve_qps_per_chip"] = \
                     record["serve_ragged_qps_per_chip"]
+        # Decode-serving arm family (r21 serve/decode tentpole):
+        # autoregressive generation through the REAL decode stack —
+        # paged KV cache, AOT prefill + decode-step program families,
+        # token-granular continuous batching.  The closed-loop child
+        # publishes TTFT percentiles + decode_tokens_per_sec_per_chip,
+        # measured N INTERLEAVED with the open-loop sustained child (r6
+        # noise protocol: alternating children so drift decorrelates)
+        # so the throughput headline carries a measured band; the
+        # sustained child paces submissions at FDT_BENCH_DECODE_QPS and
+        # publishes decode_slo_violation_pct — a closed loop
+        # self-throttles, so queueing failure only ever shows open
+        # loop.  Opt out: FDT_BENCH_DECODE=0.
+        if os.environ.get("FDT_BENCH_DECODE", "1") != "0":
+            dreps = max(1, int(os.environ.get("FDT_BENCH_DECODE_REPEATS",
+                                              "3")))
+            dg_runs, ds_runs = [], []
+            for _ in range(dreps):
+                r = _run_child("decode_gen")
+                if r and r.get("requests"):
+                    dg_runs.append(r)
+                r = _run_child("decode_sustained")
+                if r and r.get("requests"):
+                    ds_runs.append(r)
+
+            def _decode_med(key, rs):
+                vs = sorted(r[key] for r in rs if key in r)
+                return vs[len(vs) // 2] if vs else None
+
+            if dg_runs:
+                tps = sorted(r["tokens_per_sec_per_chip"]
+                             for r in dg_runs)
+                med = tps[len(tps) // 2]
+                record["decode_tokens_per_sec_per_chip"] = med
+                if len(tps) > 1 and med:
+                    record["decode_tokens_per_sec_per_chip"
+                           "_noise_band_pct"] = round(
+                        (tps[-1] - tps[0]) / med * 100.0, 1)
+                record["decode_ttft_p50_ms"] = _decode_med("ttft_p50_ms",
+                                                           dg_runs)
+                record["decode_ttft_p99_ms"] = _decode_med("ttft_p99_ms",
+                                                           dg_runs)
+            if ds_runs:
+                record["decode_slo_violation_pct"] = _decode_med(
+                    "slo_violation_pct", ds_runs)
+                record["decode_target_qps"] = ds_runs[0]["target_qps"]
+                record["decode_slo_ms"] = ds_runs[0]["slo_ms"]
         # Telemetry-overhead arm (r12 observability tentpole): the
         # per-dispatch recorder must be free — on-vs-off measured N>=5
         # times INTERLEAVED (the r6 noise protocol: alternating children
@@ -2692,7 +2901,8 @@ def main() -> None:
                     and os.environ.get("FDT_BENCH_TELEM", "1") != "0"
                     and os.environ.get("FDT_BENCH_QUANT", "1") != "0"
                     and os.environ.get("FDT_BENCH_KDIS", "1") != "0"
-                    and os.environ.get("FDT_BENCH_SERVE", "1") != "0")
+                    and os.environ.get("FDT_BENCH_SERVE", "1") != "0"
+                    and os.environ.get("FDT_BENCH_DECODE", "1") != "0")
         # r6/r7 standing-note follow-through: the A/B `*_step_ms` pairs
         # are only comparable against a LIVE record — the committed
         # baseline may still be the r5 `record_note` reconstruction,
@@ -2748,6 +2958,8 @@ def _essentials(record: dict) -> dict:
             "restart_cached_mttr_s", "restart_slice_mttr_s",
             "warm_spare_swap_s",
             "serve_p50_ms", "serve_p99_ms", "serve_qps_per_chip",
+            "decode_tokens_per_sec_per_chip", "decode_ttft_p50_ms",
+            "decode_ttft_p99_ms", "decode_slo_violation_pct",
             "telemetry_overhead_pct",
             "transformer_bs256_seq256_quant_off_step_ms",
             "transformer_bs256_seq256_int8_step_ms",

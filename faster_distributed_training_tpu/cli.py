@@ -1378,6 +1378,191 @@ def run_serving(cfg: TrainConfig, requests=None,
             recorder.close()
 
 
+def run_decode_serving(cfg: TrainConfig, prompts=None,
+                       log: Callable[[str], None] = print) -> dict:
+    """The AUTOREGRESSIVE serving entrypoint (ROADMAP item #1's online
+    half): load the trained LM artifact from ``cfg.checkpoint_dir``,
+    stand up the serve/decode stack — paged KV cache, AOT prefill +
+    decode-step program families, token-granular continuous batching —
+    push ``prompts`` (ragged int32 token arrays; a synthetic mix of
+    ``cfg.decode_requests`` when None) through it with a
+    ``cfg.decode_max_new_tokens`` budget each, and return the generated
+    token arrays + TTFT/throughput summary.
+
+    Replica layout is run_serving's SNIPPETS [3] decision verbatim:
+    REPLICATED per chip (one DecodeEngine + DecodeScheduler per local
+    device, all draining ONE queue) unless the mesh names a model axis,
+    in which case ONE model-sharded replica serves over the mesh.  The
+    multi-PROCESS front door (serve/decode/frontend.FrontDoor) stacks
+    on top of this entrypoint — each worker process runs exactly this
+    single-replica wiring."""
+    setup_platform(cfg)
+
+    import jax
+
+    from faster_distributed_training_tpu.models.decode import SamplingCfg
+    from faster_distributed_training_tpu.parallel import make_mesh
+    from faster_distributed_training_tpu.parallel.mesh import (sp_size,
+                                                               tp_size)
+    from faster_distributed_training_tpu.serve import (RequestQueue,
+                                                       load_serving_state)
+    from faster_distributed_training_tpu.serve.decode import (
+        DecodeEngine, DecodeScheduler)
+    from faster_distributed_training_tpu.telemetry import (
+        TelemetryRecorder, resolve_telemetry_dir, spans, update_manifest)
+    from faster_distributed_training_tpu.train.metrics import percentiles
+
+    mesh = make_mesh(cfg.mesh_axes, cfg.mesh_shape)
+    sharded = tp_size(mesh) > 1 or sp_size(mesh) > 1
+    recorder = None
+    prev_rec = None
+    obs = None
+    prev_obs = None
+    if cfg.telemetry and os.environ.get("FDT_TELEMETRY", "1") != "0":
+        import dataclasses
+        import time as time_mod
+
+        tdir = resolve_telemetry_dir(cfg)
+        recorder = TelemetryRecorder(tdir, log=log)
+        # MERGE (never write_manifest): the training checkpoint dir's
+        # manifest carries the r15 program table this run must not wipe
+        update_manifest(tdir, {"decode_serve": {
+            "unix_time": round(time_mod.time(), 3),
+            "config": dataclasses.asdict(cfg)}})
+        prev_rec = spans.set_recorder(recorder)
+        from faster_distributed_training_tpu.telemetry import (
+            ProgramObservatory, programs)
+        if programs.observatory_enabled():
+            obs = ProgramObservatory(recorder=recorder, log=log)
+            from faster_distributed_training_tpu.resilience \
+                .executable_cache import build_executable_cache
+            from faster_distributed_training_tpu.resilience.storage \
+                import build_backend
+            # same durable backend as the checkpoint loads — a restarted
+            # decode replica on another machine must reach the cached
+            # executables too
+            obs.executable_cache = build_executable_cache(
+                cfg,
+                backend=build_backend(
+                    getattr(cfg, "storage_backend", "posix"),
+                    cfg.checkpoint_dir, log=log),
+                mesh=mesh if sharded else None, log=log)
+            prev_obs = programs.set_observatory(obs)
+        log(f"[decode] telemetry recording to {tdir}")
+    try:
+        model, sstate, meta = load_serving_state(
+            cfg, mesh=mesh if sharded else None, log=log)
+        q = RequestQueue(cfg.seq_buckets, max_len=cfg.seq_len)
+        buckets = q.buckets
+        sampling = SamplingCfg(method=cfg.decode_sample,
+                               temperature=cfg.decode_temperature,
+                               top_k=cfg.decode_top_k, seed=cfg.seed)
+        if sharded:
+            log(f"[decode] mesh {dict(mesh.shape)} has a model axis: the "
+                f"model did not fit one chip — serving ONE model-sharded "
+                f"decode replica (SNIPPETS [3]: replicate per chip "
+                f"whenever it fits; it doesn't here)")
+            engines = [DecodeEngine(model, sstate, buckets,
+                                    batch_size=cfg.decode_batch_size,
+                                    page=cfg.decode_page,
+                                    max_pages=cfg.decode_max_pages,
+                                    sampling=sampling, mesh=mesh,
+                                    name="decode0", log=log)]
+            chips_serving = mesh.size
+        else:
+            devs = jax.local_devices()
+            n_rep = int(cfg.decode_replicas) or len(devs)
+            engines = [DecodeEngine(model, sstate, buckets,
+                                    batch_size=cfg.decode_batch_size,
+                                    page=cfg.decode_page,
+                                    max_pages=cfg.decode_max_pages,
+                                    sampling=sampling,
+                                    device=devs[i % len(devs)],
+                                    name=f"decode{i}", log=log)
+                       for i in range(n_rep)]
+            chips_serving = min(n_rep, len(devs))
+        with spans.span("decode_warmup"):
+            warm_s = sum(e.warmup() for e in engines)
+        log(f"[decode] {len(engines)} replica(s) x ({len(buckets)} "
+            f"prefill + {engines[0].max_pages} decode-step) programs "
+            f"AOT-warmed in {warm_s:.1f}s (buckets {list(buckets)}, "
+            f"page {cfg.decode_page}, {cfg.decode_batch_size} slots)")
+        scheds = [DecodeScheduler(q, e,
+                                  max_delay_ms=cfg.serve_max_delay_ms,
+                                  max_new_tokens=cfg.decode_max_new_tokens,
+                                  recorder=recorder, name=e.name, log=log)
+                  for e in engines]
+        for s in scheds:
+            s.start()
+        try:
+            if prompts is None:
+                prompts = synth_requests(cfg.decode_requests,
+                                         meta.get("vocab") or 30522,
+                                         buckets, seed=cfg.seed)
+            handles = [q.submit(t,
+                                max_new_tokens=cfg.decode_max_new_tokens)
+                       for t in prompts]
+            results = [h.wait(timeout=300.0) for h in handles]
+        finally:
+            q.close()
+            for s in scheds:
+                s.close()
+        # aggregate across schedulers: one summary over the union of
+        # their per-request samples (percentiles are over the combined
+        # population, not an average of per-replica percentiles)
+        ttft, total = [], []
+        n_req = toks = steps = prefills = 0
+        t_first, t_last = None, None
+        for s in scheds:
+            ttft += [t for t in s.ttft_ms if t is not None]
+            total += [t for t in s.total_ms if t is not None]
+            n_req += s.completed_requests
+            toks += s.generated_tokens
+            steps += s.engine.steps
+            prefills += s.engine.prefills
+            if s._t_first is not None:
+                t_first = s._t_first if t_first is None \
+                    else min(t_first, s._t_first)
+            if s._t_last is not None:
+                t_last = s._t_last if t_last is None \
+                    else max(t_last, s._t_last)
+        wall = ((t_last - t_first)
+                if (t_first is not None and t_last is not None
+                    and t_last > t_first) else 0.0)
+        pt = percentiles(ttft, qs=(50, 99))
+        pl = percentiles(total, qs=(50, 99))
+        tps = round(toks / wall, 2) if wall else 0.0
+        out = {"results": results, "meta": meta, "cfg": cfg,
+               "state": sstate,
+               "requests": n_req, "tokens": toks, "steps": steps,
+               "prefills": prefills,
+               "ttft_p50_ms": pt.get(50, 0.0),
+               "ttft_p99_ms": pt.get(99, 0.0),
+               "latency_p50_ms": pl.get(50, 0.0),
+               "latency_p99_ms": pl.get(99, 0.0),
+               "tokens_per_sec": tps,
+               "chips_serving": chips_serving,
+               "tokens_per_sec_per_chip": round(
+                   tps / max(chips_serving, 1), 2)}
+        log(f"[decode] generated {toks} tokens for {n_req} requests in "
+            f"{steps} steps ({prefills} prefills): TTFT p50 "
+            f"{out['ttft_p50_ms']} ms / p99 {out['ttft_p99_ms']} ms, "
+            f"{tps} tok/s ({out['tokens_per_sec_per_chip']}/chip)")
+        return out
+    finally:
+        if recorder is not None:
+            if obs is not None:
+                from faster_distributed_training_tpu.telemetry import (
+                    programs, update_manifest as _upd)
+                programs.set_observatory(prev_obs)
+                # decode's compile story under its OWN manifest key —
+                # "serve_compile" belongs to the classifier tier
+                _upd(recorder.directory,
+                     {"decode_compile": obs.summary()})
+            spans.set_recorder(prev_rec)
+            recorder.close()
+
+
 def main(argv=None, defaults: Optional[TrainConfig] = None,
          prog: str = "fdt") -> dict:
     parser = build_parser(prog=prog, defaults=defaults)
@@ -1400,6 +1585,21 @@ def main_serve(argv=None, defaults: Optional[TrainConfig] = None,
     # CLI use: the numbers, not the tensors — drop the logits, the live
     # param bundle and the config object (meta/summary/replica stats
     # are plain scalars)
+    for heavy in ("results", "state", "cfg"):
+        out.pop(heavy, None)
+    return out
+
+
+def main_decode(argv=None, defaults: Optional[TrainConfig] = None,
+                prog: str = "fdt-decode") -> dict:
+    """The ``decode`` CLI twin of :func:`main_serve`: same flag surface,
+    checkpoint_dir READ only, a synthetic ragged prompt mix generated
+    to ``cfg.decode_max_new_tokens`` each.  scripts/decode_smoke.py is
+    the script-level entry (with the multi-process front door on top)."""
+    parser = build_parser(prog=prog, defaults=defaults)
+    args = parser.parse_args(argv)
+    cfg = config_from_args(args, defaults=defaults)
+    out = run_decode_serving(cfg)
     for heavy in ("results", "state", "cfg"):
         out.pop(heavy, None)
     return out

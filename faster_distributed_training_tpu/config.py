@@ -443,6 +443,36 @@ class TrainConfig:
     serve_requests: int = 64          # built-in synthetic request count
                                       # for the CLI serve smoke
 
+    # -- decode serving (serve/decode/; cli.run_decode_serving) ------------
+    decode_batch_size: int = 4        # cache SLOTS per replica — the
+                                      # decode-step batch dimension a
+                                      # mid-stream admission swaps into
+    decode_page: int = 16             # KV-cache page size (tokens): the
+                                      # attention-window quantum — live
+                                      # length picks ceil(len/page)
+                                      # pages, so the decode program set
+                                      # is one program per page count,
+                                      # not per length
+    decode_max_pages: int = 0         # cache capacity in pages per slot:
+                                      # 0 = auto (largest prompt bucket
+                                      # plus one page of generation
+                                      # headroom, capped at the position
+                                      # table)
+    decode_max_new_tokens: int = 32   # per-request generation budget cap
+                                      # (a request's own max_new is
+                                      # honored up to this)
+    decode_sample: str = "greedy"     # "greedy" | "topk" — STATIC, baked
+                                      # into the compiled program set
+    decode_temperature: float = 1.0   # topk softmax temperature
+    decode_top_k: int = 40            # topk truncation (<=0 = full vocab)
+    decode_replicas: int = 0          # decode replicas: 0 = auto (one per
+                                      # local chip; 1 model-sharded group
+                                      # when the mesh has a model axis —
+                                      # same SNIPPETS [3] rule as
+                                      # serve_replicas)
+    decode_requests: int = 16         # built-in synthetic prompt count
+                                      # for the CLI decode smoke
+
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
 
@@ -802,6 +832,38 @@ def build_parser(prog: str = "fdt",
                         "this many seconds (0 = manual only)")
     p.add_argument("--serve_requests", default=d.serve_requests, type=int,
                    help="synthetic request count for the CLI serve smoke")
+    p.add_argument("--decode_batch_size", default=d.decode_batch_size,
+                   type=int,
+                   help="KV-cache slots per decode replica (the decode-"
+                        "step batch dimension admissions swap into)")
+    p.add_argument("--decode_page", default=d.decode_page, type=int,
+                   help="KV-cache page size in tokens: live length picks "
+                        "ceil(len/page) pages, so the decode program set "
+                        "is one program per page count")
+    p.add_argument("--decode_max_pages", default=d.decode_max_pages,
+                   type=int,
+                   help="cache capacity in pages per slot (0 = auto: "
+                        "largest prompt bucket + one page of headroom)")
+    p.add_argument("--decode_max_new_tokens",
+                   default=d.decode_max_new_tokens, type=int,
+                   help="per-request generation budget cap")
+    p.add_argument("--decode_sample", default=d.decode_sample,
+                   choices=["greedy", "topk"],
+                   help="sampling method, baked into the compiled decode "
+                        "programs (deterministic per (seed, request) "
+                        "either way)")
+    p.add_argument("--decode_temperature", default=d.decode_temperature,
+                   type=float, help="topk sampling temperature")
+    p.add_argument("--decode_top_k", default=d.decode_top_k, type=int,
+                   help="topk truncation; <=0 samples the full vocab")
+    p.add_argument("--decode_replicas", default=d.decode_replicas,
+                   type=int,
+                   help="decode replicas: 0 = auto (one per local chip; "
+                        "one model-sharded group when the mesh has a "
+                        "model axis)")
+    p.add_argument("--decode_requests", default=d.decode_requests,
+                   type=int,
+                   help="synthetic prompt count for the CLI decode smoke")
     return p
 
 
@@ -888,6 +950,15 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         serve_heartbeat_timeout_s=args.serve_heartbeat_timeout_s,
         serve_readmit_s=args.serve_readmit_s,
         serve_requests=args.serve_requests,
+        decode_batch_size=args.decode_batch_size,
+        decode_page=args.decode_page,
+        decode_max_pages=args.decode_max_pages,
+        decode_max_new_tokens=args.decode_max_new_tokens,
+        decode_sample=args.decode_sample,
+        decode_temperature=args.decode_temperature,
+        decode_top_k=args.decode_top_k,
+        decode_replicas=args.decode_replicas,
+        decode_requests=args.decode_requests,
     )
     cfg = resolve_tricks(cfg)
     if args.model:

@@ -1,0 +1,302 @@
+"""Decode-mode transformer: the eval forward as pure functions over the
+checkpointed param tree, split into PREFILL (full prompt, K/V out) and
+DECODE-STEP (one position against the KV cache).
+
+Why not ``model.apply`` with a flax mutable cache collection: the
+serving tier needs full control over program signatures — the decode
+step's cache is donated, its window is a STATIC slice (one AOT program
+per page count, serve/decode/engine.py), and per-slot positions/ids are
+traced vectors — none of which the module-tree plumbing expresses
+cleanly.  So this file mirrors ``models.transformer``'s eval-time math
+op-for-op, reading the exact param leaves training checkpoints carry
+(``Embeddings_0/*``, ``layer_i/{ln_attn,attn/{qkv,out},ln_ffn,ffn/
+{Dense_0,Dense_1}}``, ``ln_final``, tied ``token_embedding`` or untied
+``lm_head``).  tests/test_decode.py pins prefill logits against
+``model.apply`` and greedy tokens against the cacheless forward, so a
+drift between the mirror and the module is a test failure, not a silent
+skew.
+
+The serving-contract caveat (documented in README "Decode serving"):
+the r18 LM task trains a BIDIRECTIONAL encoder — packed stream rows
+apply no attention mask, every position sees the whole row while the
+loss shifts targets by one.  Autoregressive generation requires
+causality, so decode serving IMPOSES a causal mask at serving time:
+prefill runs the prompt under ``causal_mask`` and the cache only ever
+exposes positions <= the query's.  Generation is therefore
+self-consistent (greedy cache-vs-cacheless parity holds exactly —
+both sides causal) but is NOT the training-time conditional: the model
+was trained seeing bidirectional context it no longer gets.
+
+Supported envelope (checked by :func:`decode_spec`): ``lm_head=True``
+(an LM checkpoint — tied r19 or untied r18), fused QKV (the default
+param layout; the unfused bag-of-tricks ablation arm has a different
+tree), no quantization.  ``attention_impl``/``ffn_impl`` don't gate
+anything: all impls share the same eval math and param tree; the
+mirror computes the dense/flax composition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from faster_distributed_training_tpu import prng
+from faster_distributed_training_tpu.models.transformer import (
+    dense_attention, sinusoidal_table)
+from faster_distributed_training_tpu.ops.cached_attention import (
+    cached_attention)
+from faster_distributed_training_tpu.ops.layernorm import torch_layernorm
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeSpec:
+    """Static model geometry the decode programs close over."""
+    n_layers: int
+    h: int
+    d_model: int
+    d_ff: int
+    vocab: int
+    maxlen: int
+    tied: bool
+    dtype: Any = jnp.float32
+
+    @property
+    def d_k(self) -> int:
+        return self.d_model // self.h
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingCfg:
+    """Static sampling config — baked into the AOT programs (a runtime
+    temperature knob would be one more traced operand for no measured
+    need; the program set stays the enumerated families).
+
+    method "greedy" ignores the rest.  "topk" draws from the
+    temperature-scaled top-``top_k`` logits with the r8 fold_in key
+    chain key = fold(fold(stream(root_key(seed), "decode"), request_id),
+    position), so generation is deterministic per (seed, request) and
+    independent of batch placement, admission order, or replica."""
+    method: str = "greedy"
+    temperature: float = 1.0
+    top_k: int = 40
+    seed: int = 0
+
+
+def decode_spec(model) -> DecodeSpec:
+    """Extract the decode geometry from a built Transformer module,
+    rejecting checkpoints outside the decode envelope with actionable
+    errors (the stub-or-gate rule: unsupported is loud, not wrong)."""
+    if not getattr(model, "lm_head", False):
+        raise ValueError(
+            "decode serving needs an LM checkpoint (--task lm); this "
+            "model has the classifier head — there is nothing to "
+            "generate from")
+    if getattr(model, "quant", None) is not None:
+        raise ValueError(
+            "decode serving supports unquantized checkpoints only "
+            "(the QuantDense decode mirror is not implemented); "
+            "serve with --quant none")
+    if not getattr(model, "fused_qkv", True):
+        raise ValueError(
+            "decode serving reads the fused-QKV param layout; the "
+            "unfused ablation arm's query/key/value tree is not "
+            "mirrored")
+    return DecodeSpec(n_layers=int(model.n_layers), h=int(model.h),
+                      d_model=int(model.d_model), d_ff=int(model.d_ff),
+                      vocab=int(model.vocab), maxlen=int(model.maxlen),
+                      tied=bool(getattr(model, "tie_lm_head", False)),
+                      dtype=model.dtype)
+
+
+def causal_mask(L: int) -> jax.Array:
+    """The 4-D causal mask decode serving imposes (see the module
+    docstring).  Shape (1, 1, L, L): Transformer.__call__ broadcasts
+    only 2-D masks, 4-D passes through to the attention untouched — so
+    the SAME array drives both the prefill mirror and the cacheless
+    ``model.apply`` reference the parity tests compare against."""
+    return jnp.tril(jnp.ones((L, L), jnp.int32))[None, None]
+
+
+# -- param-leaf math (each helper mirrors one flax module's eval path) ----
+
+def _ln(x, leaf, dtype):
+    y = torch_layernorm(x.astype(jnp.float32),
+                        leaf["scale"].astype(jnp.float32),
+                        leaf["bias"].astype(jnp.float32), 1e-6)
+    return y.astype(dtype)
+
+
+def _dense(x, leaf, dtype):
+    return (x.astype(dtype) @ leaf["kernel"].astype(dtype)
+            + leaf["bias"].astype(dtype))
+
+
+def _qkv_proj(x, leaf, dtype):
+    """nn.DenseGeneral((3, h, d_k)) — (B, L, d) -> (B, L, 3, h, d_k)."""
+    y = jnp.einsum("bld,dthk->blthk", x.astype(dtype),
+                   leaf["kernel"].astype(dtype))
+    return y + leaf["bias"].astype(dtype)
+
+
+def _ffn(x, leaf, dtype):
+    hmid = _dense(x, leaf["Dense_0"], dtype)
+    hmid = jax.nn.gelu(hmid, approximate=False)
+    return _dense(hmid, leaf["Dense_1"], dtype)
+
+
+def _embed(params, tokens, positions, spec: DecodeSpec, pe_table):
+    """Embeddings + the reference's PE quirk at eval: the model feeds
+    the embeddings through dropout(emb + pe) and ADDS the result back
+    (transformer.py h = emb + encodings), so eval h0 = 2*emb + pe.
+    token_types are all zero on the serving path (pad_batch does the
+    same), so the segment term is row 0 broadcast."""
+    e = params["Embeddings_0"]
+    tok = jnp.take(e["token_embedding"], tokens,
+                   axis=0).astype(jnp.float32)
+    pos = jnp.take(e["pos_embedding"], positions,
+                   axis=0).astype(jnp.float32)
+    seg = e["segment_embedding"][0].astype(jnp.float32)
+    emb = (tok + pos + seg) * math.sqrt(spec.d_model)
+    pe = jnp.take(pe_table, positions, axis=0)
+    return (2.0 * emb + pe).astype(spec.dtype)
+
+
+def _head(h, params, spec: DecodeSpec):
+    """LM head on (..., d_model) -> fp32 (..., vocab); tied r19 (raw
+    token table transposed, fp32 accumulation) or untied r18 Dense."""
+    if spec.tied:
+        table = params["Embeddings_0"]["token_embedding"]
+        logits = jnp.dot(h.astype(spec.dtype),
+                         table.astype(spec.dtype).T,
+                         preferred_element_type=jnp.float32)
+    else:
+        logits = _dense(h, params["lm_head"], spec.dtype)
+    return logits.astype(jnp.float32)
+
+
+def _sample_keys(seed: int, req_ids, positions):
+    base = prng.stream(prng.root_key(seed), "decode")
+
+    def one(rid, pos):
+        k = jax.random.fold_in(base, jnp.asarray(rid, jnp.uint32))
+        return jax.random.fold_in(k, jnp.asarray(pos, jnp.uint32))
+
+    return jax.vmap(one)(req_ids, positions)
+
+
+def sample_tokens(logits, sampling: SamplingCfg, req_ids,
+                  positions) -> jax.Array:
+    """(B, V) fp32 logits -> (B,) int32 token ids.  ``positions`` is
+    the absolute position of the token being GENERATED (prefill: the
+    prompt length; decode step: pos + 1), which is what makes a
+    request's sample stream invariant to when it was admitted."""
+    if sampling.method == "greedy":
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if sampling.method != "topk":
+        raise ValueError(f"unknown sampling method {sampling.method!r} "
+                         f"(greedy | topk)")
+    V = logits.shape[-1]
+    k = V if sampling.top_k <= 0 else min(int(sampling.top_k), V)
+    keys = _sample_keys(sampling.seed, req_ids, positions)
+    vals, idx = jax.lax.top_k(logits, k)
+
+    def one(key, v, i):
+        g = jax.random.categorical(
+            key, v.astype(jnp.float32) / float(sampling.temperature))
+        return i[g]
+
+    return jax.vmap(one)(keys, vals, idx).astype(jnp.int32)
+
+
+# -- the two program bodies ------------------------------------------------
+
+def prefill(spec: DecodeSpec, sampling: SamplingCfg,
+            params: Dict[str, Any], tokens: jax.Array,
+            length: jax.Array, req_ids: jax.Array
+            ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Full causal forward over a (B, L) prompt bucket.
+
+    Returns (k, v, logits, first_token): per-layer keys/values stacked
+    (n_layers, B, h, L, d_k) — columns >= length[b] are computed from
+    pad tokens and carry garbage the cache's length mask never exposes
+    (causality already makes real positions independent of the pad
+    suffix) — plus the fp32 logits AT the last real position and the
+    token sampled from them (the request's first generated token, at
+    absolute position ``length``)."""
+    B, L = tokens.shape
+    pe = jnp.asarray(sinusoidal_table(spec.maxlen, spec.d_model))
+    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None, :],
+                                 (B, L))
+    h = _embed(params, tokens, positions, spec, pe)
+    mask = causal_mask(L)
+    ks, vs = [], []
+    for i in range(spec.n_layers):
+        lp = params[f"layer_{i}"]
+        a = _ln(h, lp["ln_attn"], spec.dtype)
+        qkv = _qkv_proj(a, lp["attn"]["qkv"], spec.dtype)
+        q = qkv[:, :, 0].transpose(0, 2, 1, 3)     # (B, h, L, d_k)
+        kk = qkv[:, :, 1].transpose(0, 2, 1, 3)
+        vv = qkv[:, :, 2].transpose(0, 2, 1, 3)
+        ks.append(kk)
+        vs.append(vv)
+        ctx = dense_attention(q, kk, vv, mask, 0.0, True, None)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, L, spec.d_model)
+        h = h + _dense(ctx, lp["attn"]["out"], spec.dtype)
+        f = _ln(h, lp["ln_ffn"], spec.dtype)
+        h = h + _ffn(f, lp["ffn"], spec.dtype)
+    h = _ln(h, params["ln_final"], spec.dtype)
+    h_last = h[jnp.arange(B), length - 1]          # (B, d_model)
+    logits = _head(h_last, params, spec)
+    first = sample_tokens(logits, sampling, req_ids, length)
+    return jnp.stack(ks), jnp.stack(vs), logits, first
+
+
+def decode_step(spec: DecodeSpec, sampling: SamplingCfg, window: int,
+                params: Dict[str, Any], kcache: jax.Array,
+                vcache: jax.Array, token: jax.Array, pos: jax.Array,
+                active: jax.Array, req_ids: jax.Array
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step over the whole slot batch.
+
+    kcache/vcache: (n_layers, B, h, C_max, d_k) — passed (and donated)
+    WHOLE so every page-count program shares one buffer identity; only
+    the first ``window`` columns (static, = pages * page_size) enter
+    the attention, which is what bounds the per-step cost by the
+    longest ACTIVE sequence rather than the allocation.
+    token: (B,) the token AT position ``pos`` (sampled last step);
+    pos:   (B,) its absolute position — the cache column written;
+    active:(B,) bool; inactive (free) slots run the same math on
+    dummy inputs and their outputs are dropped host-side (same
+    pad-row semantic the classifier scheduler pins).
+
+    Returns (kcache, vcache, next_token) with next_token sampled at
+    absolute position pos + 1."""
+    B = token.shape[0]
+    pe = jnp.asarray(sinusoidal_table(spec.maxlen, spec.d_model))
+    h = _embed(params, token, pos, spec, pe)[:, None, :]   # (B, 1, D)
+    rows = jnp.arange(B)
+    lengths = pos.astype(jnp.int32) + 1
+    for i in range(spec.n_layers):
+        lp = params[f"layer_{i}"]
+        a = _ln(h, lp["ln_attn"], spec.dtype)
+        qkv = _qkv_proj(a, lp["attn"]["qkv"], spec.dtype)
+        q = qkv[:, :, 0].transpose(0, 2, 1, 3)     # (B, h, 1, d_k)
+        k_new = qkv[:, 0, 1]                       # (B, h, d_k)
+        v_new = qkv[:, 0, 2]
+        kcache = kcache.at[i, rows, :, pos, :].set(k_new)
+        vcache = vcache.at[i, rows, :, pos, :].set(v_new)
+        ctx = cached_attention(q, kcache[i, :, :, :window],
+                               vcache[i, :, :, :window], lengths)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, 1, spec.d_model)
+        h = h + _dense(ctx, lp["attn"]["out"], spec.dtype)
+        f = _ln(h, lp["ln_ffn"], spec.dtype)
+        h = h + _ffn(f, lp["ffn"], spec.dtype)
+    h = _ln(h[:, 0], params["ln_final"], spec.dtype)
+    logits = _head(h, params, spec)
+    nxt = sample_tokens(logits, sampling, req_ids, pos + 1)
+    nxt = jnp.where(active, nxt, 0).astype(jnp.int32)
+    return kcache, vcache, nxt

@@ -1,0 +1,57 @@
+"""Single-position attention against a padded KV cache.
+
+The decode-mode transformer (models/decode.py) computes one query
+position per sequence per step; keys/values live in the paged cache
+(serve/decode/cache.py) whose trailing columns beyond each slot's
+current length are garbage.  This op is `models.transformer
+.dense_attention` specialized to q-length 1 with the mask built from
+per-slot lengths instead of a materialized (B, 1, 1, C) array — same
+NEG_INF constant, same fp32 softmax, same einsum contraction order.
+
+Exactness of the padding: a masked column's score is NEG_INF (-1e9),
+so after the softmax's max-subtraction its exp underflows to an exact
+fp32 0.0 and contributes exact zeros to both the normalizer and the
+probs @ v contraction — attention over a C-column cache with k valid
+entries computes the same real-column contributions as attention over
+exactly k columns.  (Token-for-token greedy parity against the
+cacheless forward is pinned by tests/test_decode.py; logits may differ
+in final ulps because XLA associates the wider reduction differently.)
+
+No Pallas kernel: decode on the serving tier is bandwidth-bound on
+reading the cache, which XLA's stock dot handles; the r15 observatory
+accounts the programs either way.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from faster_distributed_training_tpu.models.transformer import NEG_INF
+
+
+def cached_attention(q: jax.Array, kcache: jax.Array, vcache: jax.Array,
+                     lengths: jax.Array) -> jax.Array:
+    """One-position attention over the first ``lengths[b]`` cache
+    columns of each slot.
+
+    q:       (B, h, 1, d_k)  — the current position's query
+    kcache:  (B, h, C, d_k)  — keys, columns >= lengths[b] are garbage
+    vcache:  (B, h, C, d_k)
+    lengths: (B,) int32      — valid cache entries per slot (INCLUDING
+                               the current position, already written)
+    returns: (B, h, 1, d_k)
+    """
+    d_k = q.shape[-1]
+    C = kcache.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, kcache) / math.sqrt(d_k)
+    # (B, 1, 1, C) length mask, the dense_attention `mask == 0` idiom
+    valid = (jnp.arange(C, dtype=jnp.int32)[None, :]
+             < lengths[:, None].astype(jnp.int32))
+    scores = jnp.where(valid[:, None, None, :], scores,
+                       jnp.asarray(NEG_INF, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32),
+                           axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, vcache)

@@ -28,18 +28,23 @@ Layout:
     (bucket, batch) cells under a max-latency deadline, pads partial
     batches with masked rows whose outputs are dropped);
   * :mod:`replicas`   — Replica / ReplicaSet (least-loaded dispatch,
-    heartbeat staleness detach, re-admission).
+    heartbeat staleness detach, re-admission);
+  * :mod:`decode`     — the r21 autoregressive tier (paged KV cache,
+    AOT prefill/decode program families, token-granular continuous
+    batching, multi-process front door) — imported lazily by its
+    users, not re-exported here, so the classifier serve path never
+    pays the decode imports.
 """
 
 from faster_distributed_training_tpu.serve.engine import (  # noqa: F401
     InferenceEngine, ServingState, load_serving_state, pad_batch)
 from faster_distributed_training_tpu.serve.queue import (  # noqa: F401
-    RequestQueue, ServeRequest)
+    GenRequest, RequestQueue, ServeRequest)
 from faster_distributed_training_tpu.serve.replicas import (  # noqa: F401
     Replica, ReplicaSet)
 from faster_distributed_training_tpu.serve.scheduler import (  # noqa: F401
     BatchScheduler)
 
 __all__ = ["InferenceEngine", "ServingState", "load_serving_state",
-           "pad_batch", "RequestQueue", "ServeRequest", "Replica",
-           "ReplicaSet", "BatchScheduler"]
+           "pad_batch", "RequestQueue", "ServeRequest", "GenRequest",
+           "Replica", "ReplicaSet", "BatchScheduler"]

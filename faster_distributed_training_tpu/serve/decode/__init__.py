@@ -1,0 +1,30 @@
+"""KV-cache autoregressive decode serving (r21, ROADMAP item #1).
+
+Layered like the classifier serve/ stack it extends:
+
+  cache.py     — the paged KV cache: device K/V buffers sized
+                 pages*page_size plus the host-side slot table
+                 (lengths, tokens, request ids, free list);
+  engine.py    — DecodeEngine: AOT prefill-per-bucket +
+                 decode-step-per-page-count program families through
+                 the r15 observatory and the r17 executable cache;
+  scheduler.py — DecodeScheduler: the slot-granular continuous-
+                 batching loop (admit between steps, reclaim on
+                 finish);
+  frontend.py  — the multi-process front door: one worker PROCESS per
+                 replica behind a length-framed JSON socket protocol,
+                 ReplicaSet detach/readmit semantics across process
+                 death.
+"""
+
+from faster_distributed_training_tpu.serve.decode.cache import (  # noqa: F401
+    PagedKVCache)
+from faster_distributed_training_tpu.serve.decode.engine import (  # noqa: F401
+    DecodeEngine)
+from faster_distributed_training_tpu.serve.decode.frontend import (  # noqa: F401
+    FrontDoor, GenScheduler, ProcReplica, WorkerClient)
+from faster_distributed_training_tpu.serve.decode.scheduler import (  # noqa: F401
+    DecodeScheduler)
+
+__all__ = ["PagedKVCache", "DecodeEngine", "DecodeScheduler",
+           "FrontDoor", "GenScheduler", "ProcReplica", "WorkerClient"]

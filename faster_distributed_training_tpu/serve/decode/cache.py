@@ -1,0 +1,112 @@
+"""Paged KV cache: fixed-size device buffers + host-side slot table.
+
+One cache serves one decode batch of ``batch_size`` SLOTS.  The device
+arrays are allocated ONCE at the maximum window (``max_pages * page``
+columns) so every decode-step program — one per page count,
+serve/decode/engine.py — shares a single buffer identity and donation
+round-trips it; "paging" here is about the ATTENTION WINDOW, not the
+allocation: each step only reads the first ``pages * page`` columns,
+where ``pages`` is the smallest page count covering the longest active
+slot, so per-step cost tracks the live sequences while the program set
+stays the enumerated ``max_pages`` cells (never a per-length retrace).
+
+The slot table is plain host numpy — lengths, current tokens, request
+ids, active flags.  The scheduler mutates it between steps (admit /
+evict), the engine reads it to assemble each step's traced operands.
+A freed slot's device columns are NOT zeroed: the length mask in
+ops/cached_attention.py makes stale columns unobservable, and the next
+admission's prefill insert overwrites the prefix it needs (pinned by
+the mid-stream admission parity test, tests/test_decode.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as np
+
+
+class PagedKVCache:
+    """Device K/V buffers (n_layers, batch, heads, max_pages*page, d_k)
+    plus the host slot table."""
+
+    def __init__(self, spec, batch_size: int, page: int, max_pages: int):
+        import jax.numpy as jnp
+
+        if page < 1 or max_pages < 1:
+            raise ValueError(f"page {page} / max_pages {max_pages} must "
+                             f"be >= 1")
+        self.spec = spec
+        self.batch_size = int(batch_size)
+        self.page = int(page)
+        self.max_pages = int(max_pages)
+        self.capacity = self.page * self.max_pages
+        shape = (spec.n_layers, self.batch_size, spec.h, self.capacity,
+                 spec.d_k)
+        self.k = jnp.zeros(shape, spec.dtype)
+        self.v = jnp.zeros(shape, spec.dtype)
+        B = self.batch_size
+        self.lengths = np.zeros((B,), np.int32)    # valid cache columns
+        self.tokens = np.zeros((B,), np.int32)     # token AT lengths-1
+        self.req_ids = np.zeros((B,), np.int32)
+        self.active = np.zeros((B,), bool)
+
+    # -- slot management (host side, between steps) ------------------------
+
+    def free_slot(self) -> Optional[int]:
+        idle = np.flatnonzero(~self.active)
+        return int(idle[0]) if len(idle) else None
+
+    def active_slots(self) -> List[int]:
+        return [int(i) for i in np.flatnonzero(self.active)]
+
+    def admit(self, slot: int, req_id: int, prompt_len: int,
+              first_token: int) -> None:
+        """Claim ``slot`` for a prefilled request: ``prompt_len`` cache
+        columns are valid and ``first_token`` (sampled off the prefill
+        logits) is the token the next decode step consumes."""
+        if self.active[slot]:
+            raise RuntimeError(f"slot {slot} is already active")
+        if prompt_len > self.capacity:
+            raise ValueError(f"prompt of {prompt_len} exceeds cache "
+                             f"capacity {self.capacity}")
+        self.lengths[slot] = int(prompt_len)
+        self.tokens[slot] = int(first_token)
+        self.req_ids[slot] = int(req_id)
+        self.active[slot] = True
+
+    def evict(self, slot: int) -> None:
+        self.active[slot] = False
+        self.lengths[slot] = 0
+        self.tokens[slot] = 0
+        self.req_ids[slot] = 0
+
+    def advance(self, next_tokens: np.ndarray) -> None:
+        """Commit one decode step: every active slot consumed its token
+        (cache column ``lengths`` was written) and sampled the next."""
+        act = self.active
+        self.lengths[act] += 1
+        self.tokens[act] = next_tokens[act]
+
+    # -- window accounting -------------------------------------------------
+
+    def window_pages(self) -> int:
+        """Smallest page count whose window covers every active slot
+        through the NEXT step's write (column ``lengths``, 0-based —
+        hence lengths + 1 columns must be visible)."""
+        if not self.active.any():
+            return 1
+        need = int(self.lengths[self.active].max()) + 1
+        return min(self.max_pages,
+                   max(1, math.ceil(need / self.page)))
+
+    def slot_pages(self, slot: int) -> int:
+        """Pages the slot's live prefix occupies (telemetry)."""
+        return max(1, math.ceil(int(self.lengths[slot]) / self.page))
+
+    def headroom(self, slot: int) -> int:
+        """Generated tokens the slot can still take before the cache
+        (or the model's position table) runs out."""
+        cap = min(self.capacity, self.spec.maxlen)
+        return cap - int(self.lengths[slot])

@@ -1,0 +1,206 @@
+"""DecodeScheduler: token-granular continuous batching over one engine.
+
+The r16 classifier scheduler batches at REQUEST granularity — a cell
+is assembled, dispatched, and the batch's composition is frozen until
+its logits return.  Generation inverts the shape of the work: a batch
+lives for hundreds of steps and its members finish at different times.
+This loop therefore schedules at SLOT granularity, interleaving three
+phases between every decode step:
+
+  1. ADMIT — while a cache slot is free and the queue has work, drain
+     ONE request (queue.take_one: the take_cell policy at batch 1, so
+     deadline-expired buckets still beat fuller ones — the r16
+     admission rule preserved verbatim), prefill it, and swap its K/V
+     into the RUNNING batch;
+  2. STEP — one decode-step program over the whole slot batch (the
+     engine picks the page-count program covering the longest live
+     slot);
+  3. RECLAIM — requests that hit their token budget (or the cache/
+     position ceiling) are fulfilled and their slot freed for the next
+     admission.
+
+Telemetry (append-only r21 kinds): ``decode_admit`` per admission,
+``decode_step`` per step, ``slot_evict`` per reclaim.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from faster_distributed_training_tpu.serve.decode.engine import DecodeEngine
+from faster_distributed_training_tpu.serve.queue import (GenRequest,
+                                                         RequestQueue)
+
+
+class DecodeScheduler:
+    """One engine + one queue -> a slot-granular generation loop."""
+
+    def __init__(self, queue: RequestQueue, engine: DecodeEngine,
+                 max_delay_ms: float = 20.0, max_new_tokens: int = 32,
+                 recorder=None, name: str = "decode0",
+                 log: Callable[[str], None] = print):
+        self.queue = queue
+        self.engine = engine
+        self.max_delay_s = float(max_delay_ms) / 1e3
+        self.max_new_tokens = int(max_new_tokens)
+        self.recorder = recorder
+        self.name = name
+        self._log = log
+        self._slots: Dict[int, GenRequest] = {}
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # bookkeeping for summary()
+        self.completed_requests = 0
+        self.generated_tokens = 0
+        self.ttft_ms: List[float] = []
+        self.total_ms: List[float] = []
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"fdt-{self.name}")
+        self._thread.start()
+
+    def close(self, drain_s: float = 30.0) -> None:
+        """Stop admitting new work once the queue is closed (by the
+        caller), finish what is in flight (bounded), stop the loop."""
+        deadline = time.monotonic() + drain_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = bool(self._slots) or self.queue.pending()
+            if not busy:
+                break
+            time.sleep(0.01)
+        self._closed = True
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        # anything still holding a slot past the drain bound fails loud
+        with self._lock:
+            stranded = list(self._slots.items())
+            self._slots.clear()
+        for slot, req in stranded:
+            self.engine.cache.evict(slot)
+            req.fail(RuntimeError(f"decode drain timed out with request "
+                                  f"{req.id} still in slot {slot}"))
+
+    # -- the loop ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._closed:
+            running = self.engine.active_count() > 0
+            self._admit(block=not running)
+            if self.engine.active_count() == 0:
+                continue
+            t0 = time.monotonic()
+            tokens, pages = self.engine.step()
+            step_ms = (time.monotonic() - t0) * 1e3
+            if self.recorder is not None:
+                self.recorder.record_event(
+                    "decode_step", replica=self.name, pages=pages,
+                    active=len(self._slots),
+                    batch=self.engine.batch_size,
+                    step_ms=round(step_ms, 3))
+            self._reclaim(tokens)
+
+    def _admit(self, block: bool) -> None:
+        """Fill free slots from the queue.  With a running batch the
+        drain must not stall the step loop, so the queue poll is
+        non-blocking; an idle engine waits the usual take_cell bound."""
+        first = True
+        while self.engine.cache.free_slot() is not None:
+            timeout = 0.05 if (block and first) else 0.0
+            first = False
+            got = self.queue.take_one(self.max_delay_s, timeout_s=timeout)
+            if got is None:
+                return
+            bucket, req = got
+            if not isinstance(req, GenRequest):
+                req.fail(TypeError(
+                    "DecodeScheduler serves GenRequests (queue."
+                    "submit(tokens, max_new_tokens=...)); got a plain "
+                    "logits request"))
+                continue
+            now = time.monotonic()
+            slot, f_tok = self.engine.admit(req.tokens, bucket, req.id)
+            req.push_token(f_tok, time.monotonic())
+            with self._lock:
+                self._slots[slot] = req
+                if self._t_first is None:
+                    self._t_first = now
+            if self.recorder is not None:
+                self.recorder.record_event(
+                    "decode_admit", replica=self.name, slot=slot,
+                    bucket=bucket, len=req.raw_len,
+                    queue_ms=round((now - req.t_submit) * 1e3, 3))
+            # a 1-token budget is satisfied by the prefill sample alone
+            self._maybe_finish(slot, req)
+
+    def _reclaim(self, tokens: np.ndarray) -> None:
+        now = time.monotonic()
+        for slot, req in list(self._slots.items()):
+            if not self.engine.cache.active[slot]:
+                continue
+            req.push_token(int(tokens[slot]), now)
+            self._maybe_finish(slot, req)
+
+    def _maybe_finish(self, slot: int, req: GenRequest) -> None:
+        budget = min(req.max_new, self.max_new_tokens)
+        done = len(req.out) >= budget
+        reason = "budget"
+        if not done and self.engine.cache.headroom(slot) <= 0:
+            done = True
+            reason = "capacity"
+        if not done:
+            return
+        n = len(req.out)
+        self.engine.cache.evict(slot)
+        with self._lock:
+            self._slots.pop(slot, None)
+            self.completed_requests += 1
+            self.generated_tokens += n
+            self.ttft_ms.append(req.ttft_ms())
+            self._t_last = time.monotonic()
+        req.fulfill(np.asarray(req.out, np.int32), self.name,
+                    time.monotonic())
+        with self._lock:
+            self.total_ms.append(req.latency_ms())
+        if self.recorder is not None:
+            self.recorder.record_event(
+                "slot_evict", replica=self.name, slot=slot, tokens=n,
+                reason=reason)
+
+    # -- reporting ---------------------------------------------------------
+
+    def summary(self) -> dict:
+        """TTFT p50/p99 + generation throughput (nearest-rank
+        percentiles, train.metrics.percentiles — the stack's one
+        definition)."""
+        from faster_distributed_training_tpu.train.metrics import (
+            percentiles)
+        with self._lock:
+            ttft = list(self.ttft_ms)
+            total = list(self.total_ms)
+            n = self.completed_requests
+            toks = self.generated_tokens
+            wall = ((self._t_last - self._t_first)
+                    if (self._t_first is not None
+                        and self._t_last is not None
+                        and self._t_last > self._t_first) else 0.0)
+        pt = percentiles(ttft, qs=(50, 99))
+        pl = percentiles(total, qs=(50, 99))
+        return {"requests": n, "tokens": toks,
+                "steps": self.engine.steps,
+                "prefills": self.engine.prefills,
+                "ttft_p50_ms": pt.get(50, 0.0),
+                "ttft_p99_ms": pt.get(99, 0.0),
+                "latency_p50_ms": pl.get(50, 0.0),
+                "latency_p99_ms": pl.get(99, 0.0),
+                "tokens_per_sec": round(toks / wall, 2) if wall else 0.0}

@@ -1,0 +1,15 @@
+"""Decode worker-process entry point.
+
+``python -m faster_distributed_training_tpu.serve.decode.worker --cfg
+<json> --port <p> --name <n> --hb_dir <d>`` — a module the package
+``__init__`` does NOT import, so runpy executes it without the
+"already in sys.modules" double-import hazard.  All the logic lives in
+:func:`frontend.worker_main`.
+"""
+
+import sys
+
+from faster_distributed_training_tpu.serve.decode.frontend import worker_main
+
+if __name__ == "__main__":
+    sys.exit(worker_main(sys.argv[1:]))

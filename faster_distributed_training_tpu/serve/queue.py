@@ -84,6 +84,35 @@ class ServeRequest:
         return (self.t_done - self.t_submit) * 1e3
 
 
+class GenRequest(ServeRequest):
+    """A GENERATION request (r21 decode tier): prompt token ids in,
+    ``max_new`` generated token ids out.
+
+    Same lifecycle as :class:`ServeRequest` (``wait`` blocks the
+    submitter; ``result`` is the generated int32 token array — the
+    first entry is the token sampled off the prefill logits), plus the
+    token-granular bookkeeping the decode scheduler needs: ``out``
+    accumulates tokens as steps complete and ``t_first`` stamps the
+    first token for TTFT accounting."""
+
+    def __init__(self, tokens: np.ndarray, bucket: int, raw_len: int,
+                 t_submit: float, max_new: int):
+        super().__init__(tokens, bucket, raw_len, t_submit)
+        self.max_new = int(max_new)
+        self.out: List[int] = []
+        self.t_first: Optional[float] = None
+
+    def push_token(self, token: int, now: float) -> None:
+        if self.t_first is None:
+            self.t_first = now
+        self.out.append(int(token))
+
+    def ttft_ms(self) -> Optional[float]:
+        if self.t_first is None:
+            return None
+        return (self.t_first - self.t_submit) * 1e3
+
+
 class RequestQueue:
     """Thread-safe bucket-binned request queue (one FIFO per bucket)."""
 
@@ -99,15 +128,34 @@ class RequestQueue:
         self._closed = False
         self.submitted = 0
 
-    def submit(self, tokens) -> ServeRequest:
+    def submit(self, tokens,
+               max_new_tokens: Optional[int] = None,
+               req_id: Optional[int] = None) -> ServeRequest:
         """Bin a ragged token array into its bucket FIFO; returns the
         request handle the submitter waits on.  Over-long requests run
         truncated at the largest bucket (logged on the request via
-        raw_len, never rejected — the production semantic)."""
+        raw_len, never rejected — the production semantic).
+
+        ``max_new_tokens`` switches the request to GENERATION (r21): a
+        :class:`GenRequest` whose result is the generated token array
+        instead of a logits row.  Both kinds share the one queue and
+        the one bucket-selection rule.  ``req_id`` overrides the
+        auto-assigned id — the decode front door threads the PARENT
+        request id through the wire so a generation retried on a
+        different worker process samples with the same fold_in key and
+        returns the same tokens."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         raw_len = len(tokens)
         bucket = select_bucket(max(raw_len, 1), self.buckets)
-        req = ServeRequest(tokens[:bucket], bucket, raw_len, self._clock())
+        if max_new_tokens is not None:
+            req: ServeRequest = GenRequest(tokens[:bucket], bucket,
+                                           raw_len, self._clock(),
+                                           max_new=max_new_tokens)
+        else:
+            req = ServeRequest(tokens[:bucket], bucket, raw_len,
+                               self._clock())
+        if req_id is not None:
+            req.id = int(req_id)
         with self._cond:
             if self._closed:
                 raise RuntimeError("RequestQueue is closed")
@@ -158,6 +206,20 @@ class RequestQueue:
                 if wait <= 0:
                     return None
                 self._cond.wait(wait)
+
+    def take_one(self, max_delay_s: float, timeout_s: float = 0.05
+                 ) -> Optional[Tuple[int, ServeRequest]]:
+        """SLOT-granular drain (r21 decode tier): one (bucket, request),
+        or None.  Exactly take_cell's policy at batch size 1 — with
+        every non-empty bucket "full", rule 1 still runs first, so a
+        deadline-expired bucket's head beats rule 2's smallest-bucket
+        preference: the r16 deadline-first admission rule, preserved at
+        token granularity."""
+        cell = self.take_cell(1, max_delay_s, timeout_s=timeout_s)
+        if cell is None:
+            return None
+        bucket, reqs = cell
+        return bucket, reqs[0]
 
     def _oldest_locked(self) -> Optional[float]:
         ts = [f[0].t_submit for f in self._fifos.values() if f]

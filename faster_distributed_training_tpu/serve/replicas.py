@@ -281,6 +281,12 @@ class ReplicaSet:
             if r.alive:
                 return
             r.start()
+            if not r.alive:
+                # a process-backed replica whose respawn wasn't ready
+                # (serve/decode/frontend.ProcReplica): start() re-armed
+                # its detach timer instead of raising — not a
+                # re-admission, the monitor will try again
+                return
             self.replica_readmissions += 1
         self._log(f"[serve] replica {r.name} RE-ADMITTED "
                   f"({len(self.live())} live)")

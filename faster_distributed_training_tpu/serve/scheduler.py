@@ -147,12 +147,20 @@ class BatchScheduler:
             if cell is None:
                 continue
             bucket, requests = cell
-            batch, n_real = pad_batch(requests, bucket, self.batch_size)
+            batch, n_real = self._assemble(bucket, requests)
             work = _Work(bucket, requests, batch, n_real,
                          on_done=self._on_done,
                          max_attempts=max(len(self.replicas.replicas),
                                           1) + 1)
             self._dispatch(work)
+
+    def _assemble(self, bucket: int, requests: List[ServeRequest]):
+        """Cell -> engine payload (batch, n_real).  The classifier tier
+        pads to the compiled batch dimension; the decode front door
+        (serve/decode/frontend.py) overrides this seam with the
+        identity wire payload — everything else (dispatch, parking,
+        attempt budget, replica rescue) is shared."""
+        return pad_batch(requests, bucket, self.batch_size)
 
     def _dispatch(self, work: _Work) -> None:
         work.attempts += 1

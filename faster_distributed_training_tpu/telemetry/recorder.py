@@ -76,6 +76,17 @@ by ``"kind"``:
                  (r17 warm-spare lifecycle: parked / claimed — the
                   swap duration rides the goodput stream as
                   warm_spare_swap_s)
+  ``decode_admit`` {replica, slot, bucket, len, queue_ms}
+                 (one per mid-stream admission: a prompt prefilled and
+                  its K/V swapped into a running decode batch —
+                  serve/decode/scheduler.py)
+  ``decode_step``  {replica, pages, active, batch, step_ms}
+                 (one per decode step over the slot batch; pages is
+                  the page-count program that served it)
+  ``slot_evict``   {replica, slot, tokens, reason}
+                 (one per reclaimed cache slot; reason "budget" =
+                  token budget met, "capacity" = cache/position
+                  ceiling)
 
 r17 append-only field addition: ``program`` records grew
 ``cache_source`` ({deserialized, persistent_dir, compiled} — which
@@ -178,6 +189,16 @@ TELEMETRY_SCHEMA: Dict[str, Optional[frozenset]] = {
     # (warm_spare_swap_s)
     "spare": frozenset({"event", "spare", "seat", "slice", "generation",
                         "step"}),
+    # r21 decode serving tier (serve/decode/scheduler.py) — append-only:
+    # one decode_admit per mid-stream admission (prefill + K/V swap into
+    # the running batch), one decode_step per slot-batch decode step
+    # (pages = the page-count program that served it), one slot_evict
+    # per reclaimed cache slot (reason: budget | capacity)
+    "decode_admit": frozenset({"replica", "slot", "bucket", "len",
+                               "queue_ms"}),
+    "decode_step": frozenset({"replica", "pages", "active", "batch",
+                              "step_ms"}),
+    "slot_evict": frozenset({"replica", "slot", "tokens", "reason"}),
 }
 # kinds that once existed but are no longer emitted (none today): the
 # lint's staleness rule consults this instead of forcing removal from

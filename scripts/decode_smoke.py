@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Decode-serving smoke (r21 serve/decode tentpole acceptance): train a
+tiny LM checkpoint, stand up the multi-PROCESS front door on CPU, and
+assert the decode tier's load-bearing contracts:
+
+  1. **survivor completion** — one worker process SIGKILLed while a
+     batch of generations is in flight: every stream still finishes
+     (the dead process is detached via the socket-error / HB-marker
+     path and its work re-dispatches to the survivor), and no
+     generation is truncated.
+  2. **process re-admission** — the killed replica auto-respawns (its
+     warmup riding the executable cache, not a cold compile), passes
+     its readiness ping, and SERVES again.
+  3. **decode telemetry** — the r21 append-only kinds (`decode_admit`,
+     `decode_step`, `slot_evict`) actually landed in the worker
+     processes' telemetry files.
+
+Prints TTFT/latency stats last.  Exit 0 = all contracts hold.  Run:
+
+    python scripts/decode_smoke.py
+    python scripts/decode_smoke.py --requests 24 --max_new 8
+
+tests/test_decode.py invokes main() in-process (tier-1), pointing
+--dir at its module-scoped checkpoint so the smoke skips retraining.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+BUCKETS = (8, 16)
+SEQ_LEN = 16
+
+
+def _cfg(d: str):
+    """The smoke's tiny-LM serving config — shared with the tier-1
+    wrapper's module fixture so the in-process run skips retraining."""
+    from faster_distributed_training_tpu.config import TrainConfig
+    return TrainConfig(model="transformer", dataset="stream", task="lm",
+                       data_path="stream",
+                       stream_dir=os.path.join(d, "stream"),
+                       batch_size=8, seq_len=SEQ_LEN, n_layers=1,
+                       d_model=16, d_ff=32, n_heads=2, epochs=1,
+                       steps_per_dispatch=2, stream_window=4,
+                       optimizer="sgd", precision="fp32", plot=False,
+                       workers=0, log_every=0, donate=False,
+                       checkpoint_dir=os.path.join(d, "ckpt"),
+                       seq_buckets=BUCKETS, decode_batch_size=2,
+                       decode_page=4, decode_max_new_tokens=8,
+                       device="cpu")
+
+
+def _train(cfg) -> None:
+    from faster_distributed_training_tpu.cli import run_training
+    from faster_distributed_training_tpu.data.stream import (
+        synthetic_corpus, write_lm_corpus)
+    texts = synthetic_corpus(40, seed=3, words_per_doc=(25, 50))
+    write_lm_corpus(cfg.stream_dir, texts, seq_len=SEQ_LEN,
+                    rows_per_shard=16, val_fraction=0.15)
+    run_training(cfg, log=lambda *_: None)
+
+
+def _telemetry_kinds(run_dir: str) -> set:
+    kinds = set()
+    for path in glob.glob(os.path.join(run_dir, "telemetry_*",
+                                       "host_*.jsonl")):
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    kinds.add(json.loads(line).get("kind"))
+        except OSError:
+            pass
+    return kinds
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", default="", help="checkpoint dir (default: "
+                    "fresh temp dir, trained then removed)")
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--max_new", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from faster_distributed_training_tpu.serve.decode import FrontDoor
+    from faster_distributed_training_tpu.serve.engine import (
+        load_serving_state)
+    from faster_distributed_training_tpu.train.metrics import percentiles
+
+    d = args.dir or tempfile.mkdtemp(prefix="fdt_decode_smoke_")
+    cleanup = not args.dir
+    cfg = _cfg(d)
+    failures = []
+    fd = None
+    run_dir = os.path.join(d, "frontdoor")
+    try:
+        try:
+            _model, _sstate, meta = load_serving_state(
+                cfg, log=lambda *_: None)
+        except FileNotFoundError:
+            print(f"[smoke] training a tiny LM checkpoint into {d} ...")
+            _train(cfg)
+            _model, _sstate, meta = load_serving_state(
+                cfg, log=lambda *_: None)
+        vocab = int(meta.get("vocab") or 256)
+
+        fd = FrontDoor(cfg, n_workers=2, run_dir=run_dir,
+                       heartbeat_timeout_s=60.0, marker_timeout_s=5.0,
+                       readmit_after_s=1.0)
+        t0 = time.monotonic()
+        fd.start()
+        print(f"[smoke] front door up ({len(fd.replicas)} worker "
+              f"processes) in {time.monotonic() - t0:.1f}s")
+
+        # -- contract 1: kill one process mid-generation ---------------
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(1, vocab, size=int(rng.integers(3, 9))
+                                ).astype(np.int32)
+                   for _ in range(args.requests)]
+        handles = [fd.submit(t, max_new=args.max_new) for t in prompts]
+        victim = fd.replicas[0]
+        victim.kill()
+        print(f"[smoke] SIGKILLed {victim.name} with "
+              f"{len(handles)} generations in flight")
+        results = [h.wait(timeout=300.0) for h in handles]
+        short = [len(r) for r in results if len(r) != args.max_new]
+        if short:
+            failures.append(f"{len(short)} stream(s) truncated after "
+                            f"the kill: lengths {short}")
+        else:
+            print(f"[smoke] PASS: all {len(results)} streams finished "
+                  f"({args.max_new} tokens each) on the survivor")
+
+        # -- contract 2: auto-respawn + re-admission -------------------
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            if victim.respawns >= 1 and all(r.alive
+                                            for r in fd.replicas):
+                break
+            time.sleep(0.2)
+        if victim.respawns < 1 or not all(r.alive for r in fd.replicas):
+            failures.append(
+                f"killed worker not respawned/re-admitted "
+                f"(respawns={victim.respawns}, "
+                f"alive={[r.alive for r in fd.replicas]})")
+        else:
+            served_before = victim.served_requests
+            more = [fd.submit(t, max_new=4) for t in prompts[:6]]
+            for h in more:
+                h.wait(timeout=120.0)
+            # drive a few more rounds if the survivor absorbed them all
+            waited = time.monotonic() + 30.0
+            while (victim.served_requests == served_before
+                   and time.monotonic() < waited):
+                h = fd.submit(prompts[0], max_new=2)
+                h.wait(timeout=60.0)
+            if victim.served_requests == served_before:
+                failures.append("re-admitted worker never served again")
+            else:
+                print(f"[smoke] PASS: {victim.name} respawned "
+                      f"({victim.respawns}x) and served "
+                      f"{victim.served_requests - served_before} more "
+                      f"generation(s); stats: {fd.rset.stats()}")
+
+        ttft = [h.ttft_ms() for h in handles if h.ttft_ms() is not None]
+        lat = [h.latency_ms() for h in handles
+               if h.latency_ms() is not None]
+        pt = percentiles(ttft, qs=(50, 99))
+        pl = percentiles(lat, qs=(50, 99))
+        fd.close()
+        fd = None
+
+        # -- contract 3: decode telemetry kinds landed -----------------
+        kinds = _telemetry_kinds(run_dir)
+        want = {"decode_admit", "decode_step", "slot_evict"}
+        if not want <= kinds:
+            failures.append(f"decode telemetry kinds missing under "
+                            f"{run_dir}: saw {sorted(kinds)}")
+        else:
+            print(f"[smoke] PASS: decode telemetry kinds recorded "
+                  f"({sorted(want)})")
+
+        print(f"[smoke] ttft_p50={pt.get(50, 0.0)} ms  "
+              f"ttft_p99={pt.get(99, 0.0)} ms  "
+              f"latency_p50={pl.get(50, 0.0)} ms  "
+              f"latency_p99={pl.get(99, 0.0)} ms  "
+              f"({len(handles)} generations x {args.max_new} tokens)")
+    finally:
+        if fd is not None:
+            fd.close()
+        if cleanup:
+            shutil.rmtree(d, ignore_errors=True)
+
+    if failures:
+        for f in failures:
+            print(f"[smoke] FAIL: {f}")
+        return 1
+    print("[smoke] decode smoke PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,473 @@
+"""serve/decode subsystem tests (r21 tentpole).
+
+Coverage map (the ISSUE's acceptance list):
+  * prefill parity: the models/decode mirror's last-position logits
+    match ``model.apply`` under the imposed causal mask;
+  * cache correctness: greedy paged-KV decode is token-for-token
+    identical to the cacheless full-context argmax loop, and a
+    mid-stream admission is BITWISE-invisible to the already-running
+    stream (P=1-always page config, so both runs use the same decode
+    program);
+  * program-set pin: one engine warms EXACTLY
+    {prefill:L<bucket>} x {decode:P1..Pmax}, zero retraces, and ragged
+    traffic compiles nothing new after warmup;
+  * load_serving_state restores tied AND untied lm_head checkpoints
+    (untied -> tied via the warned train/checkpoint.py shim);
+  * the r21 telemetry kinds (decode_admit/decode_step/slot_evict) land
+    append-only, and run_decode_serving produces its summary;
+  * the front-door machinery (GenScheduler payload shape, ProcReplica
+    marker/process staleness) against fakes — no processes;
+  * the full scripts/decode_smoke.py in-process (two worker PROCESSES,
+    SIGKILL mid-generation, survivor finishes, respawn serves again).
+
+The LM checkpoint is module-scoped and shared with the smoke wrapper
+(exactly the smoke's own config, so the wrapper skips retraining).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from faster_distributed_training_tpu.serve import RequestQueue
+from faster_distributed_training_tpu.serve.queue import GenRequest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SILENT = lambda *_: None                                 # noqa: E731
+
+
+def _load_smoke():
+    spec = importlib.util.spec_from_file_location(
+        "decode_smoke", os.path.join(REPO, "scripts", "decode_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def smoke_mod():
+    return _load_smoke()
+
+
+@pytest.fixture(scope="module")
+def lm_dir(tmp_path_factory, smoke_mod):
+    """One tiny next-token LM checkpoint (stream corpus, seq 16,
+    buckets (8, 16)) shared by every engine test AND the smoke wrapper
+    (exactly the smoke's config, so the wrapper skips retraining)."""
+    d = str(tmp_path_factory.mktemp("decode_ckpt"))
+    smoke_mod._train(smoke_mod._cfg(d))
+    return d
+
+
+@pytest.fixture(scope="module")
+def served_lm(lm_dir, smoke_mod):
+    from faster_distributed_training_tpu.serve import load_serving_state
+    cfg = smoke_mod._cfg(lm_dir)
+    model, sstate, meta = load_serving_state(cfg, log=_SILENT)
+    return cfg, model, sstate, meta
+
+
+@pytest.fixture(scope="module")
+def obs_engine(served_lm):
+    """(observatory, engine): the shared DecodeEngine, warmed THROUGH
+    the r15 observatory so the program-set pin reads what actually
+    compiled."""
+    from faster_distributed_training_tpu.serve.decode import DecodeEngine
+    from faster_distributed_training_tpu.telemetry.programs import (
+        ProgramObservatory, set_observatory)
+    _cfg, model, sstate, _meta = served_lm
+    obs = ProgramObservatory(log=_SILENT)
+    prev = set_observatory(obs)
+    try:
+        eng = DecodeEngine(model, sstate, (8, 16), batch_size=2, page=4,
+                           name="serve", log=_SILENT)
+        eng.warmup()
+    finally:
+        set_observatory(prev)
+    return obs, eng
+
+
+def _ref_logits(model, sstate, toks):
+    """Cacheless reference: full forward under the imposed causal mask
+    (the serving contract — the r18 LM trains bidirectional, decode
+    serves causal), per-position fp32 logits."""
+    from faster_distributed_training_tpu.models.decode import causal_mask
+    toks = np.asarray(toks, np.int32)
+    out = model.apply({"params": sstate.params["model"],
+                       "batch_stats": sstate.batch_stats},
+                      toks[None, :], mask=causal_mask(len(toks)),
+                      train=False)
+    return np.asarray(out)[0]
+
+
+def _run_gen(engine, prompts, max_new, recorder=None):
+    """One DecodeScheduler pass over ``prompts``; returns the generated
+    token lists in submission order."""
+    from faster_distributed_training_tpu.serve.decode import (
+        DecodeScheduler)
+    q = RequestQueue(engine.buckets, max_len=max(engine.buckets))
+    sched = DecodeScheduler(q, engine, max_new_tokens=max_new,
+                            recorder=recorder, name=engine.name,
+                            log=_SILENT)
+    sched.start()
+    try:
+        handles = [q.submit(t, max_new_tokens=max_new) for t in prompts]
+        return [list(map(int, h.wait(timeout=120.0))) for h in handles]
+    finally:
+        q.close()
+        sched.close()
+
+
+# -- prefill parity + cache correctness ------------------------------------
+
+def test_prefill_logits_match_cacheless(served_lm, obs_engine):
+    _cfg, model, sstate, meta = served_lm
+    _obs, eng = obs_engine
+    rng = np.random.default_rng(0)
+    for L in (3, 7, 8, 11, 16):
+        toks = rng.integers(1, meta["vocab"], size=L).astype(np.int32)
+        bucket = 8 if L <= 8 else 16
+        got = eng.prefill_logits(toks, bucket)
+        want = _ref_logits(model, sstate, toks)[-1]
+        assert np.allclose(got, want, atol=1e-4), \
+            (L, float(np.max(np.abs(got - want))))
+
+
+def test_greedy_paged_decode_matches_cacheless_argmax(served_lm,
+                                                      obs_engine):
+    """The headline cache-correctness claim: greedy decode through the
+    paged KV cache is token-for-token identical to re-running the full
+    cacheless forward and taking argmax at every step."""
+    _cfg, model, sstate, meta = served_lm
+    _obs, eng = obs_engine
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, meta["vocab"], size=int(n)
+                            ).astype(np.int32) for n in (3, 5, 7, 4)]
+    got = _run_gen(eng, prompts, max_new=6)
+    for p, g in zip(prompts, got):
+        seq = list(map(int, p))
+        want = []
+        for _ in range(6):
+            if len(seq) >= 16:
+                break
+            t = int(np.argmax(_ref_logits(model, sstate, seq)[-1]))
+            want.append(t)
+            seq.append(t)
+        assert g == want, (list(p), g, want)
+
+
+def _drive(eng, plan, max_new):
+    """Drive the engine with the scheduler's exact slot protocol
+    (admit -> push prefill token, step -> push tokens[slot], evict at
+    budget) under a DETERMINISTIC admission plan: ``plan`` is a list
+    of (admit_at_step, prompt).  Returns token lists in plan order."""
+    outs = [None] * len(plan)
+    slot_of = {}
+    pending = list(enumerate(plan))
+    steps = 0
+    while pending or slot_of:
+        while (pending and pending[0][1][0] <= steps
+               and eng.cache.free_slot() is not None):
+            i, (_at, prompt) = pending.pop(0)
+            slot, first = eng.admit(np.asarray(prompt, np.int32), 8, i)
+            outs[i] = [int(first)]
+            if len(outs[i]) >= max_new:
+                eng.cache.evict(slot)
+            else:
+                slot_of[slot] = i
+        if not slot_of:
+            steps += 1
+            continue
+        tokens, _pages = eng.step()
+        steps += 1
+        for slot, i in list(slot_of.items()):
+            outs[i].append(int(tokens[slot]))
+            if len(outs[i]) >= max_new:
+                eng.cache.evict(slot)
+                del slot_of[slot]
+    return outs
+
+
+def test_mid_stream_admission_is_bitwise_invisible(served_lm):
+    """Token-granular continuous batching must not perturb a running
+    stream: generate A alone, B alone, then A with B admitted
+    MID-STREAM (after A's 2nd decode step, by construction) — all on a
+    P=1-always cache (page 16 covers the whole position table, so
+    every run uses the one decode:P1 program) — and require
+    bitwise-identical tokens."""
+    from faster_distributed_training_tpu.serve.decode import DecodeEngine
+    _cfg, model, sstate, meta = served_lm
+    eng = DecodeEngine(model, sstate, (8, 16), batch_size=2, page=16,
+                       max_pages=1, name="p1", log=_SILENT)
+    assert eng.max_pages == 1
+    rng = np.random.default_rng(2)
+    a = rng.integers(1, meta["vocab"], size=5).astype(np.int32)
+    b = rng.integers(1, meta["vocab"], size=7).astype(np.int32)
+    solo_a = _drive(eng, [(0, a)], max_new=6)[0]
+    solo_b = _drive(eng, [(0, b)], max_new=6)[0]
+    mixed = _drive(eng, [(0, a), (2, b)], max_new=6)
+    assert mixed[0] == solo_a
+    assert mixed[1] == solo_b
+
+
+# -- program-set pin -------------------------------------------------------
+
+def test_decode_program_set_fixed_and_pinned(served_lm, obs_engine):
+    """The zero-retrace acceptance: warmup compiles EXACTLY the two
+    program families, every program lowers once, the observatory saw
+    no retrace, and ragged traffic afterwards compiles NOTHING new."""
+    _cfg, _model, _sstate, meta = served_lm
+    obs, eng = obs_engine
+    want = ({f"serve:prefill:L{b}" for b in (8, 16)}
+            | {f"serve:decode:P{p}" for p in range(1, eng.max_pages + 1)})
+    assert set(obs.programs) == want
+    summ = obs.summary()
+    assert summ["retraces"] == []
+    assert all(p["lowerings"] == 1 for p in summ["programs"])
+    n_pre = len(eng._prefill_compiled)
+    n_dec = len(eng._decode_compiled)
+    # ragged mix covering both buckets and every live page count
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, meta["vocab"], size=int(n)
+                            ).astype(np.int32)
+               for n in (3, 8, 9, 12, 16, 4, 11, 6)]
+    _run_gen(eng, prompts, max_new=5)
+    assert len(eng._prefill_compiled) == n_pre
+    assert len(eng._decode_compiled) == n_dec
+    assert set(obs.programs) == want
+
+
+# -- checkpoint restore: tied AND untied lm_head ---------------------------
+
+def test_load_serving_state_tied_and_untied_head(lm_dir, smoke_mod,
+                                                 tmp_path):
+    """Satellite (a): an UNTIED (r18 separate-lm_head) checkpoint
+    restores for serving both ways — exactly (tie_lm_head=False) and
+    into a tied model through the warned compat shim."""
+    from faster_distributed_training_tpu.cli import run_training
+    from faster_distributed_training_tpu.models.decode import decode_spec
+    from faster_distributed_training_tpu.serve import load_serving_state
+
+    d = str(tmp_path / "untied")
+    base = smoke_mod._cfg(d).replace(tie_lm_head=False)
+    # reuse the module corpus — only the checkpoint differs
+    base = base.replace(stream_dir=os.path.join(lm_dir, "stream"))
+    run_training(base, log=_SILENT)
+
+    # exact restore: the untied head is served as-is
+    model_u, sstate_u, meta_u = load_serving_state(base, log=_SILENT)
+    assert decode_spec(model_u).tied is False
+    assert "lm_head" in sstate_u.params["model"]
+    toks = np.arange(1, 7, dtype=np.int32)
+    got = _ref_logits(model_u, sstate_u, toks)
+    assert got.shape == (6, meta_u["vocab"])
+
+    # untied -> tied: the warned compat shim drops the projection
+    tied = base.replace(tie_lm_head=True)
+    with pytest.warns(UserWarning, match="untied-lm-head"):
+        model_t, sstate_t, _meta = load_serving_state(tied, log=_SILENT)
+    assert decode_spec(model_t).tied is True
+    assert "lm_head" not in sstate_t.params["model"]
+    # and the tied restore actually serves (logits from embedding^T)
+    got_t = _ref_logits(model_t, sstate_t, toks)
+    assert got_t.shape == got.shape and np.isfinite(got_t).all()
+
+
+# -- telemetry + the serving entrypoint ------------------------------------
+
+def test_decode_telemetry_kinds_recorded(served_lm, obs_engine,
+                                         tmp_path):
+    from faster_distributed_training_tpu.telemetry.recorder import (
+        TelemetryRecorder)
+    _cfg, _model, _sstate, meta = served_lm
+    _obs, eng = obs_engine
+    rec = TelemetryRecorder(str(tmp_path / "telem"), log=_SILENT)
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(1, meta["vocab"], size=int(n)
+                            ).astype(np.int32) for n in (3, 9, 5)]
+    _run_gen(eng, prompts, max_new=4, recorder=rec)
+    rec.close()
+    kinds = set()
+    with open(rec.path) as fh:
+        for line in fh:
+            kinds.add(json.loads(line).get("kind"))
+    assert {"decode_admit", "decode_step", "slot_evict"} <= kinds
+
+
+def test_run_decode_serving_end_to_end(lm_dir, smoke_mod):
+    """cli.run_decode_serving: summary keys, per-prompt results, and
+    the decode_compile manifest section (the r15/r17 observe-and-cache
+    path at the entrypoint level)."""
+    from faster_distributed_training_tpu.cli import run_decode_serving
+    cfg = smoke_mod._cfg(lm_dir).replace(
+        decode_replicas=1, decode_requests=4, decode_max_new_tokens=4,
+        telemetry_dir=os.path.join(lm_dir, "telemetry_e2e"))
+    out = run_decode_serving(cfg, log=_SILENT)
+    assert out["requests"] == 4
+    assert out["tokens"] == 4 * 4
+    assert len(out["results"]) == 4
+    assert all(len(r) == 4 for r in out["results"])
+    assert out["tokens_per_sec_per_chip"] > 0
+    assert out["ttft_p50_ms"] >= 0 and out["ttft_p99_ms"] >= 0
+    with open(os.path.join(lm_dir, "telemetry_e2e",
+                           "manifest.json")) as fh:
+        manifest = json.load(fh)
+    assert "decode_compile" in manifest
+    progs = {p["name"] for p in manifest["decode_compile"]["programs"]}
+    assert any(n.startswith("decode0:prefill:L") for n in progs)
+    assert any(n.startswith("decode0:decode:P") for n in progs)
+
+
+# -- front-door machinery against fakes (no processes) ---------------------
+
+def test_gen_scheduler_payload_and_fulfill():
+    """GenScheduler assembles the identity wire payload (cells of ONE
+    GenRequest) and fulfills with the replica's token array."""
+    from faster_distributed_training_tpu.serve import Replica, ReplicaSet
+    from faster_distributed_training_tpu.serve.decode import GenScheduler
+
+    class FakeWorker:
+        def predict_batch(self, payload):
+            # echo: i-th generated token = prompt length + i
+            n = len(payload["tokens"])
+            return np.arange(n, n + payload["max_new"], dtype=np.int32)
+
+    rep = Replica("w0", FakeWorker(), log=_SILENT)
+    rset = ReplicaSet([rep], heartbeat_timeout_s=5.0, log=_SILENT)
+    q = RequestQueue((8,), max_len=8)
+    sched = GenScheduler(q, rset, max_delay_ms=5.0, log=_SILENT)
+    sched.start()
+    try:
+        h = q.submit(np.arange(1, 4, dtype=np.int32), max_new_tokens=3)
+        assert isinstance(h, GenRequest)
+        got = h.wait(timeout=10.0)
+        assert list(map(int, got)) == [3, 4, 5]
+        assert sched.completed_requests == 1
+    finally:
+        q.close()
+        sched.close()
+    # classifier-style submits (no max_new_tokens) are rejected loudly,
+    # not mis-served — the _assemble seam refuses non-GenRequests
+    q2 = RequestQueue((8,), max_len=8)
+    plain = q2.submit(np.arange(1, 4, dtype=np.int32))
+    assert not isinstance(plain, GenRequest)
+    with pytest.raises(TypeError):
+        sched._assemble(8, [plain])
+
+
+def test_proc_replica_staleness_and_failed_respawn(tmp_path):
+    """ProcReplica liveness seams without real workers: a dead process
+    or a stale HB marker flips ``stale``; a respawn whose readiness
+    ping fails re-arms the detach timer instead of raising into the
+    watchdog, and ReplicaSet.readmit does NOT count it."""
+    from faster_distributed_training_tpu.serve import ReplicaSet
+    from faster_distributed_training_tpu.serve.decode import ProcReplica
+    from faster_distributed_training_tpu.serve.decode.frontend import (
+        WorkerClient)
+
+    class FakeProc:
+        def __init__(self):
+            self.dead = False
+
+        def poll(self):
+            return 1 if self.dead else None
+
+        def kill(self):
+            self.dead = True
+
+    hb = tmp_path / "HB_w0"
+    hb.write_text(str(time.time()))
+    proc = FakeProc()
+    # port 1 is never listening: the ping fails after the short budget
+    client = WorkerClient(1, connect_timeout_s=0.3)
+    r = ProcReplica("w0", lambda: proc, client, hb_path=str(hb),
+                    marker_timeout_s=0.2, log=_SILENT)
+    rset = ReplicaSet([r], heartbeat_timeout_s=60.0, log=_SILENT)
+
+    # failed readiness ping: no raise, replica stays detached, timer
+    # re-armed, readmission NOT counted
+    r.start()
+    assert r.alive is False and r.detached_at is not None
+    rset.readmit(r)
+    assert r.alive is False
+    assert rset.replica_readmissions == 0
+
+    # pretend the worker came up: alive, fresh marker -> not stale
+    r.alive = True
+    r.last_beat = time.monotonic()
+    hb.write_text(str(time.time()))
+    os.utime(hb)
+    assert not r.stale(time.monotonic(), timeout_s=60.0)
+    # process death flips staleness immediately
+    proc.dead = True
+    assert r.stale(time.monotonic(), timeout_s=60.0)
+    # process alive but the marker went stale (wedged worker)
+    proc.dead = False
+    old = time.time() - 5.0
+    os.utime(hb, (old, old))
+    assert r.stale(time.monotonic(), timeout_s=60.0)
+
+
+# -- the full smoke, in-process (tier-1 acceptance) ------------------------
+
+def test_decode_smoke_in_process(lm_dir, smoke_mod, capsys):
+    rc = smoke_mod.main(["--dir", lm_dir, "--requests", "8",
+                         "--max_new", "6"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "decode smoke PASSED" in out
+    assert "ttft_p50=" in out
+
+
+@pytest.mark.slow
+def test_decode_smoke_heavy(lm_dir, smoke_mod, capsys):
+    """The heavier twin: more streams in flight across the kill."""
+    rc = smoke_mod.main(["--dir", lm_dir, "--requests", "24",
+                         "--max_new", "8"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "decode smoke PASSED" in out
+
+
+@pytest.mark.slow
+def test_topk_sampling_deterministic_per_seed_and_request(served_lm):
+    """Temperature/top-k sampling folds (seed, request id) into the
+    key: the same request re-generated returns identical tokens, and
+    two different request ids diverge."""
+    from faster_distributed_training_tpu.models.decode import SamplingCfg
+    from faster_distributed_training_tpu.serve.decode import (
+        DecodeEngine, DecodeScheduler)
+    _cfg, model, sstate, meta = served_lm
+    # very hot temperature, full vocab: the tiny LM trained under the
+    # suite's 8-device env is near-one-hot (top-1/top-2 logit gap ~85),
+    # so any cool sampling collapses to the greedy stream for EVERY
+    # key — divergence between request ids needs real entropy per step
+    eng = DecodeEngine(model, sstate, (8, 16), batch_size=2, page=4,
+                       sampling=SamplingCfg(method="topk",
+                                            temperature=100.0, top_k=0,
+                                            seed=7),
+                       name="topk", log=_SILENT)
+    prompt = np.arange(1, 6, dtype=np.int32)
+
+    def run_with_id(req_id):
+        q = RequestQueue((8, 16), max_len=16)
+        sched = DecodeScheduler(q, eng, max_new_tokens=8, name="topk",
+                                log=_SILENT)
+        sched.start()
+        try:
+            h = q.submit(prompt, max_new_tokens=8, req_id=req_id)
+            return list(map(int, h.wait(timeout=120.0)))
+        finally:
+            q.close()
+            sched.close()
+
+    a1 = run_with_id(1001)
+    a2 = run_with_id(1001)
+    b = run_with_id(1002)
+    assert a1 == a2
+    assert a1 != b

@@ -264,6 +264,9 @@ class TestDenseAttentionDropoutRouting:
 
 
 class TestTransformerHashDropout:
+    @pytest.mark.slow  # r21 budget diet: 13 s — hash-dropout math,
+    # engine routing, and placement invariance keep their tier-1 unit
+    # tests; the full fwd+bwd transformer train smoke runs slow
     def test_transformer_trains_with_hash_dropout(self):
         """Default transformer fwd+bwd with dropout_impl=hash: loss finite,
         grads finite, train-mode output differs from eval (regularizer

@@ -230,6 +230,11 @@ class TestFFNMegatronTp:
                                    rtol=1e-5, atol=1e-6,
                                    err_msg=str(mesh_spec))
 
+    @pytest.mark.slow  # r21 budget diet: 22 s — tier-1 keeps the
+    # dropout-off forward parity across mesh specs (above), the
+    # quantized-sublayer amax-globalization pin, and the flash-side
+    # dropout placement-invariance tests; the FFN global-column
+    # (col0/cols_glob) dropout + grads pin runs in the slow tier
     def test_dropout_placement_invariant_and_grads(self, requires_devices,
                                                    devices8):
         """Hidden dropout on GLOBAL d_ff columns (col0/cols_glob), conn

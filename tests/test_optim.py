@@ -184,6 +184,10 @@ class TestNGDOracle:
         np.testing.assert_allclose(float(jnp.linalg.norm(out["emb"])),
                                    float(jnp.linalg.norm(g)), rtol=1e-3)
 
+    @pytest.mark.slow  # r21 budget diet: 15 s — NGD-on-transformer
+    # coverage survives tier-1 via the grouped/ungrouped oracles, the
+    # default-policy rescale pin above, and the e2e training suites;
+    # this vocab-sized-embedding convergence smoke runs slow
     def test_transformer_shaped_training_moves_with_default_policy(self):
         """Tiny transformer-shaped smoke with a vocab-sized embedding
         under the DEFAULT max_dim policy: a few NGD steps on a fixed

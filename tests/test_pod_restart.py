@@ -1427,6 +1427,12 @@ def _smoke_reference_digest(mod):
     return _SMOKE_REF["digest"]
 
 
+@pytest.mark.slow  # r21 budget diet: 35 s (includes the in-process
+# reference training the other smoke variants share) — process-level
+# kill/respawn keeps a tier-1 representative in the decode smoke
+# wrapper (tests/test_decode.py::test_decode_smoke_in_process: real
+# SIGKILL of a spawned worker + respawn/readmit), and bitwise
+# kill-at-N resume stays tier-1 in test_mesh2d/test_resilience
 def test_pod_restart_smoke(monkeypatch):
     """scripts/pod_restart_smoke.py end-to-end: a REAL two-process
     simulated pod (coordination genuinely cross-process through the
@@ -1454,6 +1460,12 @@ def test_pod_restart_smoke_fake_object_store(monkeypatch):
                     backend="fake_object_store") == 0
 
 
+@pytest.mark.slow  # r21 budget diet: 32 s — the plain
+# test_pod_restart_smoke stays tier-1 for the restart flow; the r17
+# cache_source=deserialized contract keeps tier-1 coverage via the
+# manifest compile-table tests and the decode program-pin test (which
+# round-trips the executable cache), and the MTTR A/B stays with the
+# bench restart_mttr_s vs restart_cached_mttr_s arms
 def test_pod_restart_smoke_cache(monkeypatch):
     """r17 acceptance: scripts/pod_restart_smoke.py --cache — crash +
     process relaunch with the executable cache armed: the relaunched

@@ -615,6 +615,11 @@ class TestAccuracyPin:
     def bf16_path_acc(self, tmp_path_factory):
         return self._acc(tmp_path_factory.mktemp("acc_none"), "none")
 
+    @pytest.mark.slow  # r21 budget diet: ~50 s (24 s bf16 fixture +
+    # 26 s int8 arm) — with all three pin arms slow, the ±0.3 pp
+    # convergence protocol runs in the slow tier only; tier-1 keeps the
+    # int8 GEMM-math oracles, TestQuantTrainingE2E full-path runs, and
+    # the tp-mesh routing tests
     def test_int8_final_eval_within_pin(self, bf16_path_acc,
                                         tmp_path_factory):
         acc = self._acc(tmp_path_factory.mktemp("acc_int8"), "int8")

@@ -404,3 +404,41 @@ def test_serve_smoke_in_process(trained_dir, smoke_mod, capsys):
     assert rc == 0, out
     assert "serving smoke PASSED" in out
     assert "p50=" in out and "p99=" in out and "qps=" in out
+
+
+# -- tp>1 model-sharded serving (the SNIPPETS [3] fallback path) -----------
+
+def test_tp2_mesh_serving_matches_1d_replica(trained_dir, smoke_mod):
+    """End-to-end tp=2 serving for the classifier path: the SAME
+    ragged request mix through (a) the default replicated-per-chip
+    layout and (b) a (dp=1, tp=2) mesh — run_serving must take the
+    model-sharded branch (SNIPPETS [3]: replicate whenever the model
+    fits one chip; a named model axis says it doesn't), log that
+    decision, and return per-request logits matching the 1D replica.
+    Tolerance is fp32-accumulation loose (the tp program reduces
+    partial products across shards in a different order)."""
+    from faster_distributed_training_tpu.cli import run_serving
+    from faster_distributed_training_tpu.serve import load_serving_state
+
+    base = smoke_mod._cfg(trained_dir, "posix", "int8").replace(
+        telemetry=False, serve_requests=6)
+    _m, _s, meta = load_serving_state(base, log=lambda *_: None)
+    reqs = smoke_mod._ragged_mix(6, meta["vocab"], seed=5)
+
+    out1 = run_serving(base, requests=reqs, log=lambda *_: None)
+
+    logs = []
+    tp = base.replace(mesh_axes=("dp", "tp"), mesh_shape=(1, 2))
+    out2 = run_serving(tp, requests=reqs,
+                       log=lambda m: logs.append(str(m)))
+    assert any("model-sharded replica group" in m for m in logs)
+    assert out2["chips_serving"] == 2
+
+    assert len(out1["results"]) == len(out2["results"]) == len(reqs)
+    for i, (r1, r2) in enumerate(zip(out1["results"], out2["results"])):
+        a, b = np.asarray(r1, np.float32), np.asarray(r2, np.float32)
+        assert a.shape == b.shape
+        assert np.allclose(a, b, atol=1e-4), \
+            (i, float(np.max(np.abs(a - b))))
+        # the decision both layouts must agree on
+        assert int(np.argmax(a)) == int(np.argmax(b))

@@ -47,6 +47,9 @@ class TestSweep:
         # ranked best-first
         assert results[0]["best_acc"] >= results[-1]["best_acc"]
 
+    @pytest.mark.slow  # r21 budget diet: 18 s (a real 1-trial resnet
+    # sweep) — the ranked two-result sweep test above keeps tier-1
+    # sweep-machinery coverage; the int-grid parse contract runs slow
     def test_int_fields_stay_int(self, tmp_path):
         # the float grid parse must not turn epochs=1.0 into a float config
         base = TrainConfig(model="resnet18", dataset="synthetic",
