@@ -1050,6 +1050,70 @@ def timed_restart_slice_mttr() -> dict:
             "errors": errors, "die_at": die_at}
 
 
+def timed_pp_pipeline(pp: int) -> dict:
+    """Pipeline weak-scaling rung (r22 pp tentpole): a simulated pod of
+    ``pp`` slices (virtual host devices — the same tier-1 simulation
+    seam as timed_restart_slice_mttr), pp = one pipeline stage per
+    slice, model DEPTH grown with the slice count (weak scaling: fixed
+    work per slice).  Ideal pipelining holds step time ~flat as depth
+    scales; the executed rotation schedule genuinely pays the
+    (S-1)/(M+S-1) fill/drain bubble, so the rung reports the schedule
+    it actually ran (n_ticks, bubble share, per-stage idle ticks)
+    beside the measured step time.  pp=1 is the unstaged baseline rung
+    through the SAME child path.  Tiny by design: the arm measures the
+    pipeline machinery; real-DCN numbers are a ROADMAP carryover."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from faster_distributed_training_tpu.config import TrainConfig
+    from faster_distributed_training_tpu.parallel.mesh import make_mesh
+    from faster_distributed_training_tpu.parallel.pipeline import (
+        build_pipeline_spec)
+    from faster_distributed_training_tpu.cli import build_model
+    from faster_distributed_training_tpu.train.state import (
+        create_train_state)
+    from faster_distributed_training_tpu.train.steps import make_train_step
+
+    devices = jax.devices()
+    if len(devices) < pp:
+        return {"skipped": f"pp={pp} rung needs {pp} devices, host "
+                           f"exposes {len(devices)}"}
+    steps = int(os.environ.get("FDT_BENCH_PP_STEPS", "10"))
+    cfg = TrainConfig(model="transformer", dataset="synthetic", task="lm",
+                      batch_size=16, seq_len=32, n_layers=2 * pp,
+                      d_model=64, d_ff=128, n_heads=4,
+                      dropout_impl="none", optimizer="sgd",
+                      precision="fp32", donate=False, num_classes=4)
+    mesh = make_mesh(("dp", "pp"), (1, pp), devices[:pp])
+    spec = build_pipeline_spec(cfg, mesh)   # None at pp=1 (baseline rung)
+    model = build_model(cfg, vocab_size=256, mesh=None)
+    sample = jnp.zeros((cfg.batch_size, cfg.seq_len), jnp.int32)
+    state = create_train_state(model, optax.sgd(0.01), sample,
+                               jax.random.PRNGKey(0),
+                               init_kwargs={"train": True})
+    batch = {"tokens": jax.random.randint(
+        jax.random.PRNGKey(1), (cfg.batch_size, cfg.seq_len), 0, 256)}
+    step_fn = jax.jit(make_train_step(cfg, pipeline=spec), donate_argnums=0)
+    with mesh:
+        for _ in range(3):
+            state, m = step_fn(state, batch)
+        jax.block_until_ready(m)
+        t0 = time.monotonic()
+        for _ in range(steps):
+            state, m = step_fn(state, batch)
+        jax.block_until_ready(m)
+    out = {"elapsed": time.monotonic() - t0, "steps_timed": steps,
+           "n_stages": 1 if spec is None else spec.n_stages,
+           "n_layers": cfg.n_layers}
+    if spec is not None:
+        out.update(n_microbatches=spec.n_microbatches,
+                   n_ticks=spec.n_ticks,
+                   bubble_pct=round(spec.bubble_pct, 2),
+                   stage_idle_ticks=spec.n_stages - 1)
+    return out
+
+
 # Serving-latency mixes (r16 serve/ tentpole): one tiny checkpoint,
 # three batch/length request mixes through the REAL serve stack —
 # continuous-batching queue, AOT-warmed per-bucket programs, 2
@@ -1666,7 +1730,15 @@ _ABS_PP_WORSE_IF_UP = {"ngd_overhead_pct": 1.5,
                        # decode loop lost real headroom (the wide
                        # tolerance absorbs CPU-host scheduler jitter
                        # on a ~24-request sample: one request = ~4pp)
-                       "decode_slo_violation_pct": 5.0}
+                       "decode_slo_violation_pct": 5.0,
+                       # r22 pp tentpole: the executed schedule's
+                       # fill/drain bubble share, (S-1)/(M+S-1), at the
+                       # headline rung — analytic from the schedule the
+                       # program actually ran, so a move means the
+                       # stage/microbatch resolution itself changed
+                       # (e.g. auto-microbatching picked a smaller M);
+                       # 5pp absorbs one step of the M ladder
+                       "pipeline_bubble_pct": 5.0}
 # -- guard-drift registry (r13 satellite; scripts/check_bench_arms.py) --
 # Every record key a bench arm can emit, as fnmatch patterns.  The lint
 # cross-checks this registry against (a) the *_step_ms string literals
@@ -1755,6 +1827,16 @@ PRODUCED_METRIC_PATTERNS = (
     "decode_tokens_per_sec_per_chip",
     "decode_ttft_p50_ms", "decode_ttft_p99_ms",
     "decode_slo_violation_pct",
+    # r22 pipeline arms (pp tentpole): weak-scaling ladder over
+    # simulated pods of {1,2,4} slices (pp = one stage per slice, depth
+    # grown with the slice count) + the executed schedule's bubble
+    # share and per-stage idle time from the headline (largest) rung.
+    # EXACT rung keys, not a weak_scaling_* wildcard — same reasoning
+    # as the per-config transformer arms above.
+    "weak_scaling_slice1_step_ms",
+    "weak_scaling_slice2_step_ms",
+    "weak_scaling_slice4_step_ms",
+    "pipeline_bubble_pct", "pp_stage_idle_ms",
 )
 # *_step_ms arms measured N-interleaved with a published noise band:
 NOISE_BANDED_STEP_MS = (
@@ -1790,6 +1872,13 @@ SINGLE_RUN_STEP_MS = (
     # resnet_bs512_k1_step_ms published beside it (banding the pair
     # would re-measure the ladder cell a third time for no information)
     "opt_offload_step_ms",
+    # r22 weak-scaling rungs: single-run simulated-pod arms (like
+    # restart_slice_mttr — each rung spins up a virtual multi-slice
+    # pod; interleaving the ladder N times would triple a machinery
+    # measurement whose real-DCN twin is a ROADMAP carryover anyway)
+    "weak_scaling_slice1_step_ms",
+    "weak_scaling_slice2_step_ms",
+    "weak_scaling_slice4_step_ms",
 )
 
 # documented intentional trades: still FLAGGED (honesty first) but
@@ -2087,6 +2176,19 @@ def main() -> None:
         # r14 elastic-recovery arm: simulated 2-slice pod, one slice
         # killed and re-admitted; detect + hold + restore decomposition
         print(json.dumps(timed_restart_slice_mttr()))
+        return
+    if child.startswith("pp_"):
+        # r22 pipeline weak-scaling rung: simulated pod of N slices,
+        # pp = one stage per slice, depth grown with the slice count.
+        # The parent cannot widen its own device view, so each rung's
+        # child forces virtual host devices BEFORE the backend
+        # initializes (harmless off-CPU: the flag only shapes the host
+        # platform; a real multi-chip backend serves the rung as-is).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8")
+        print(json.dumps(timed_pp_pipeline(int(child[len("pp_"):]))))
         return
     if child.startswith("serve_"):
         # r16 serving arm: one batch/length request mix through the
@@ -2810,6 +2912,34 @@ def main() -> None:
                 if r and "elapsed" in r:
                     record["opt_offload_step_ms"] = round(
                         r["elapsed"] / r["steps_timed"] * 1e3, 3)
+        # Pipeline weak-scaling ladder (r22 pp tentpole): simulated
+        # pods of {1, 2, 4} slices (virtual host devices — the same
+        # tier-1 simulation seam as restart_slice_mttr), pp = one
+        # stage per slice, model depth grown with the slice count.
+        # Ideal pipelining holds step time ~flat across the rungs;
+        # the headline (largest) rung also publishes the executed
+        # schedule's fill/drain bubble share (pipeline_bubble_pct,
+        # guarded above) and the per-stage idle time it implies
+        # (pp_stage_idle_ms = idle ticks x measured tick time).  CPU-
+        # container rungs measure the rotation/collective machinery —
+        # real-DCN numbers land with the first live multi-slice bench
+        # (ROADMAP carryover).  Opt out: FDT_BENCH_PP=0.
+        if os.environ.get("FDT_BENCH_PP", "1") != "0":
+            for npp in (1, 2, 4):
+                r = _run_child(f"pp_{npp}")
+                if r and "elapsed" in r:
+                    pp_ms = round(r["elapsed"] / r["steps_timed"] * 1e3, 3)
+                    record[f"weak_scaling_slice{npp}_step_ms"] = pp_ms
+                    if r.get("n_stages", 1) > 1:
+                        record["pipeline_bubble_pct"] = r["bubble_pct"]
+                        record["pp_n_stages"] = r["n_stages"]
+                        record["pp_n_microbatches"] = r["n_microbatches"]
+                        record["pp_stage_idle_ms"] = round(
+                            pp_ms / r["n_ticks"] * r["stage_idle_ticks"],
+                            3)
+                elif r and r.get("skipped"):
+                    # no silent caps: an unservable rung is recorded
+                    record[f"pp_slice{npp}_note"] = r["skipped"]
         # Eval throughput under the guard (VERDICT r5 #7): the real
         # pad-and-mask eval step at each workload's headline shape.
         ev = _run_child("eval_resnet")
@@ -2902,7 +3032,8 @@ def main() -> None:
                     and os.environ.get("FDT_BENCH_QUANT", "1") != "0"
                     and os.environ.get("FDT_BENCH_KDIS", "1") != "0"
                     and os.environ.get("FDT_BENCH_SERVE", "1") != "0"
-                    and os.environ.get("FDT_BENCH_DECODE", "1") != "0")
+                    and os.environ.get("FDT_BENCH_DECODE", "1") != "0"
+                    and os.environ.get("FDT_BENCH_PP", "1") != "0")
         # r6/r7 standing-note follow-through: the A/B `*_step_ms` pairs
         # are only comparable against a LIVE record — the committed
         # baseline may still be the r5 `record_note` reconstruction,
@@ -2981,6 +3112,9 @@ def _essentials(record: dict) -> dict:
             "opt_offload_step_ms",
             "data_path_host_step_ms", "data_path_resident_step_ms",
             "data_path_stream_step_ms", "stream_stall_pct",
+            "weak_scaling_slice1_step_ms", "weak_scaling_slice2_step_ms",
+            "weak_scaling_slice4_step_ms",
+            "pipeline_bubble_pct", "pp_stage_idle_ms",
             "bench_unix_time", "regression_baseline_file")
     ess = {"essentials": True, "full_record": BENCH_LATEST}
     for k in keys:

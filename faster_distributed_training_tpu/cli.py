@@ -416,6 +416,18 @@ def resolve_attention(cfg: TrainConfig, mesh=None) -> str:
     asserts every routed cell's arm actually exists in bench.py."""
     if cfg.attention:
         return cfg.attention
+    if (getattr(cfg, "task", "cls") == "lm"
+            and getattr(cfg, "lm_causal", False)):
+        # --lm_causal (r22): the model combines a causal [1,1,L,L] (or
+        # joint [B,1,L,L]) mask into attention at TRAINING time, and
+        # dense is the only impl whose mask path takes a full
+        # query-by-key mask — flash accepts key-padding masks only
+        # (ops/flash_attention.py flash mask contract) and ring/ulysses
+        # shard L.  Routed here so every auto-resolved causal config
+        # lands on a mask-capable impl; an explicit --attention above
+        # still wins and build_model's capability fallback reroutes it
+        # with a warning.
+        return "dense"
     from faster_distributed_training_tpu.parallel.mesh import (
         seq_parallel_axis)
     # route against the axis the model will EXECUTE over
@@ -460,6 +472,19 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
         impl = resolve_attention(cfg, mesh)
         tp = tp_size(mesh)
         sp_axis, sp_ax_size = seq_parallel_axis(mesh)
+        causal = (getattr(cfg, "task", "cls") == "lm"
+                  and getattr(cfg, "lm_causal", False))
+        if causal and impl != "dense":
+            # REGISTERED warned fallback: an explicit --attention that
+            # can't take the full causal mask (flash = key-padding only;
+            # ring/ulysses shard L) reroutes to dense — same policy as
+            # the shard_map capability fallbacks below
+            import warnings
+            warnings.warn(
+                f"--lm_causal needs a full [B,1,L,L] attention mask; "
+                f"impl {impl!r} only takes key-padding masks — using "
+                f"'dense' attention", stacklevel=2)
+            impl = "dense"
         from faster_distributed_training_tpu.parallel import kernel_shard
         if impl == "flash" and tp > 1 \
                 and not kernel_shard.flash_serviceable(mesh, cfg.n_heads):
@@ -623,7 +648,8 @@ def build_model(cfg: TrainConfig, vocab_size: Optional[int] = None,
                          lm_head=getattr(cfg, "task", "cls") == "lm",
                          tie_lm_head=(getattr(cfg, "task", "cls") == "lm"
                                       and getattr(cfg, "tie_lm_head",
-                                                  True)))
+                                                  True)),
+                         causal=causal)
     if (getattr(cfg, "quant", "none") or "none") != "none":
         import warnings
         warnings.warn(
@@ -824,6 +850,24 @@ def run_training(cfg: TrainConfig,
     vocab = train_ds.vocab_size() if is_text else None
     model = build_model(cfg, vocab_size=vocab, mesh=mesh)
 
+    # pp>1 (r22): the third parallelism axis — encoder layers staged
+    # over pp, microbatched 1F1B inside the K-dispatch scan.  Every
+    # routing decision (stage assignment, microbatch count, collective
+    # placement) is made HERE, once, in parallel/pipeline.py and dumped
+    # as one rule table into manifest.json beside the compile table.
+    # None on every pp=1 mesh — those programs stay byte-identical.
+    from faster_distributed_training_tpu.parallel.pipeline import (
+        build_pipeline_spec, pipeline_rules, stage_idle_ticks)
+    pipeline = build_pipeline_spec(cfg, mesh)
+    if pipeline is not None:
+        log(f"[pipeline] pp={pipeline.n_stages} stages x "
+            f"{pipeline.n_microbatches} microbatches "
+            f"({pipeline.schedule}): layers "
+            f"{[list(s) for s in pipeline.stage_layers]}, "
+            f"bubble {pipeline.bubble_pct:.1f}% "
+            f"({pipeline.n_ticks} ticks/step; stage boundary = "
+            f"collective-permute over pp, the DCN hop)")
+
     train_loader, eval_loader, steps_per_epoch = make_loaders(
         cfg, train_ds, eval_ds, dp=dp_size(mesh))
 
@@ -859,12 +903,13 @@ def run_training(cfg: TrainConfig,
     # replicated params onto the sp axis between donated steps
     # (measured: an sp mesh without the pin re-sharded pos_embedding
     # over sp after step 1 and the donated recall mismatched)
-    from faster_distributed_training_tpu.parallel.mesh import (sp_size,
+    from faster_distributed_training_tpu.parallel.mesh import (pp_size,
+                                                               sp_size,
                                                                tp_size)
     shardings = (train_state_shardings(state, mesh, cfg)
                  if cfg.host_offload or cfg.offload_opt_state
                  or cfg.overlap_grad_reduce or tp_size(mesh) > 1
-                 or sp_size(mesh) > 1 else None)
+                 or sp_size(mesh) > 1 or pp_size(mesh) > 1 else None)
     state = shard_train_state(state, mesh, cfg, shardings=shardings)
 
     # TRAIN augmentation lives inside the train step now (steps.py):
@@ -1007,7 +1052,31 @@ def run_training(cfg: TrainConfig,
         if telemetry.pi == 0:
             write_manifest(telemetry.directory, cfg, mesh,
                            extra={"steps_per_epoch": steps_per_epoch,
-                                  "workload": ckpt_name})
+                                  "workload": ckpt_name,
+                                  # the pp routing/stage rule table —
+                                  # one inspectable record of every
+                                  # pipeline decision, beside the
+                                  # compile table telemetry.close merges
+                                  "pipeline": pipeline_rules(pipeline,
+                                                             cfg)})
+        if pipeline is not None:
+            # schedule accounting into the telemetry stream: the
+            # analytic bubble (the executed program pays exactly this —
+            # fill/drain ticks compute on discarded microbatches) and the
+            # per-stage idle/active tick split the pp_stage_idle_ms
+            # bench arm scales by measured tick time
+            telemetry.recorder.record_event(
+                "pp_bubble", n_stages=pipeline.n_stages,
+                n_microbatches=pipeline.n_microbatches,
+                n_ticks=pipeline.n_ticks, schedule=pipeline.schedule,
+                bubble_pct=round(pipeline.bubble_pct, 3))
+            for s, idle in enumerate(stage_idle_ticks(pipeline)):
+                telemetry.recorder.record_event(
+                    "pp_stage", stage=s,
+                    layers=[f"layer_{i}"
+                            for i in pipeline.stage_layers[s]],
+                    idle_ticks=idle,
+                    active_ticks=pipeline.n_microbatches)
         if res is not None:
             # restart/preemption/peer-failure counters land in the
             # stream as they happen (goodput.set_event_sink)
@@ -1046,7 +1115,7 @@ def run_training(cfg: TrainConfig,
                           state_shardings=shardings, resilience=res,
                           put_stacked=put_stacked, resident=resident,
                           telemetry=telemetry, profiler=profiler,
-                          stream=stream)
+                          stream=stream, pipeline=pipeline)
 
         # restored states (host numpy) must land back on the run's
         # sharding policy — placement.place_on_shardings, shared with

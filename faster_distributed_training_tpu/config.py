@@ -42,6 +42,25 @@ class TrainConfig:
                                       # checkpoints restore into tied
                                       # models via a warned compat shim
                                       # (train/checkpoint.py)
+    lm_causal: bool = False           # --task lm: apply the causal mask
+                                      # at TRAINING time so the trained
+                                      # conditional matches the mask
+                                      # decode serving imposes (closes
+                                      # the r21 train/decode mismatch;
+                                      # resolve_attention routes it to
+                                      # the dense impl — flash is key-
+                                      # padding-only)
+    pp_microbatches: int = 0          # M on a pp>1 mesh: microbatches
+                                      # per step through the staged
+                                      # encoder (parallel/pipeline.py).
+                                      # 0 = auto (largest divisor of the
+                                      # batch in [S, 2S] — 2S halves the
+                                      # bubble vs M=S); must divide
+                                      # --batch_size when set
+    pp_schedule: str = "1f1b"         # 1f1b (contiguous stages) |
+                                      # interleaved (round-robin layer
+                                      # chunks, v=2) — stage ASSIGNMENT
+                                      # only; the tick loop is shared
 
     # -- optimization (reference flag surface) ----------------------------
     lr: float = 0.1
@@ -562,9 +581,11 @@ def build_parser(prog: str = "fdt",
                         "gradient half (ops/quant.py)")
     p.add_argument("--mesh", default="", type=str,
                    help="mesh as axis=size pairs, e.g. 'dp=4,tp=2' (a 2D "
-                        "(data, model) mesh) or 'dp=4,fsdp=2'; axis "
-                        "aliases: model/mp=tp, seq/context=sp (default: "
-                        "all devices on dp)")
+                        "(data, model) mesh), 'dp=4,fsdp=2', or "
+                        "'dp=2,tp=2,pp=2' (3D: pipeline stages over pp — "
+                        "the axis that spans DCN between slices); axis "
+                        "aliases: model/mp=tp, seq/context=sp, "
+                        "pipe/stage=pp (default: all devices on dp)")
     p.add_argument("--fsdp", action="store_true", help="fully-shard params/opt state")
     p.add_argument("--zero1", action="store_true",
                    help="shard only optimizer state over the data axes "
@@ -741,6 +762,26 @@ def build_parser(prog: str = "fdt",
                         "token_embedding (logits = h @ E^T, the r19 "
                         "default; untied checkpoints restore into tied "
                         "models via a warned compat shim)")
+    p.add_argument("--lm_causal", action="store_true",
+                   help="--task lm: apply the causal (next-token) mask "
+                        "at TRAINING time, matching the mask decode "
+                        "serving imposes — without it the model trains "
+                        "bidirectional and decodes causal (the r21 "
+                        "mismatch).  Routes attention to the dense impl "
+                        "(the only one whose mask path takes a full "
+                        "[B,1,L,L] mask)")
+    p.add_argument("--pp_microbatches", default=d.pp_microbatches,
+                   type=int,
+                   help="pipeline microbatches M per step on a pp>1 "
+                        "mesh (must divide --batch_size); 0 = auto "
+                        "(largest divisor in [S, 2S] — bubble "
+                        "(S-1)/(M+S-1))")
+    p.add_argument("--pp_schedule", default=d.pp_schedule,
+                   choices=["1f1b", "interleaved"],
+                   help="pipeline stage assignment: 1f1b = contiguous "
+                        "layer blocks; interleaved = round-robin chunks "
+                        "(v=2 virtual stages per stage where the depth "
+                        "allows)")
     p.add_argument("--stream_dir", default=d.stream_dir, type=str,
                    help="sharded stream dataset root (train/ + test/ "
                         "subdirs; scripts/shard_dataset.py writes one) — "
@@ -903,6 +944,9 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         device=args.device, precision=args.precision, quant=args.quant,
         quant_grad=args.quant_grad,
         tie_lm_head=not args.untie_lm_head,
+        lm_causal=args.lm_causal,
+        pp_microbatches=args.pp_microbatches,
+        pp_schedule=args.pp_schedule,
         fsdp=args.fsdp, zero1=args.zero1, host_offload=args.host_offload,
         zero_opt=not args.no_zero_opt,
         offload_opt_state=args.offload_opt_state,

@@ -740,10 +740,22 @@ class Transformer(nn.Module):
                                    # False = the r18 untied nn.Dense
                                    # head (checkpoint-compatible via the
                                    # train/checkpoint.py compat shim)
+    causal: bool = False           # --lm_causal (r22): apply the causal
+                                   # mask at TRAINING time so the
+                                   # trained conditional matches the
+                                   # mask decode imposes at serving
+                                   # (closes the r21 train/decode
+                                   # mismatch).  Combined with any
+                                   # padding mask below; routed to the
+                                   # dense impl by resolve_attention —
+                                   # flash only accepts key-padding
+                                   # masks (ops/flash_attention.py) and
+                                   # ring/ulysses shard L.
 
     @nn.compact
     def __call__(self, x: jax.Array, token_types: Optional[jax.Array] = None,
-                 mask: Optional[jax.Array] = None, train: bool = True):
+                 mask: Optional[jax.Array] = None, train: bool = True,
+                 pp_spec: Optional[Any] = None):
         B, L = x.shape
         if token_types is None:
             token_types = jnp.zeros_like(x)
@@ -760,6 +772,13 @@ class Transformer(nn.Module):
 
         if mask is not None and mask.ndim == 2:   # (B, L) padding mask
             mask = mask[:, None, None, :]          # broadcast over heads+query
+        if self.causal:
+            # causal (next-token) mask, combined with any padding mask:
+            # (1,1,L,L) alone broadcasts over batch+heads; against a
+            # (B,1,1,L) padding mask the product is the (B,1,L,L) joint
+            # mask every query row honors
+            cm = jnp.tril(jnp.ones((L, L), jnp.int32))[None, None, :, :]
+            mask = cm if mask is None else mask * cm
 
         # Each encoder layer is one EncoderLayer module; with remat=True the
         # selected policy (remat_policy) decides WHAT backward recomputes:
@@ -797,16 +816,87 @@ class Transformer(nn.Module):
         # replay (flash_attention docstring).  "ffn" checkpoints only
         # the FFN sublayer, so attention keeps the saved-stats backward.
         flash_save_stats = not (self.remat and self.remat_policy != "ffn")
-        for i in range(self.n_layers):
-            h = layer_cls(self.h, self.d_model, self.d_ff,
-                          self.dropout_connection_attention,
-                          self.dropout_connection_ffn,
-                          self.dropout_attention, self.dropout_ffn,
-                          self.dtype, self.param_dtype,
-                          self.attention_impl, self.mesh, self.sp_axis,
-                          self.dropout_impl, remat_ffn, self.fused_qkv,
-                          self.ffn_impl, flash_save_stats, self.quant,
-                          name=f"layer_{i}")(h, mask, train)
+        if pp_spec is None:
+            for i in range(self.n_layers):
+                h = layer_cls(self.h, self.d_model, self.d_ff,
+                              self.dropout_connection_attention,
+                              self.dropout_connection_ffn,
+                              self.dropout_attention, self.dropout_ffn,
+                              self.dtype, self.param_dtype,
+                              self.attention_impl, self.mesh, self.sp_axis,
+                              self.dropout_impl, remat_ffn, self.fused_qkv,
+                              self.ffn_impl, flash_save_stats, self.quant,
+                              name=f"layer_{i}")(h, mask, train)
+        else:
+            # Pipelined encoder (parallel/pipeline.py — the module
+            # docstring there is the spec).  Selected by python
+            # branching on pp_spec BEFORE trace, so pp=1 programs (the
+            # branch above) stay byte-identical to r21.  Same modules,
+            # same names, same param tree: only the execution order of
+            # the layer applications changes — the batch runs as M
+            # microbatches through S rotating stage slots, and jax.grad
+            # through the rotation yields the reversed (1F1B) backward
+            # pipeline.
+            from faster_distributed_training_tpu.parallel.pipeline import (
+                constrain_stage_buffer)
+            spec = pp_spec
+            M, S = spec.n_microbatches, spec.n_stages
+            if B % M:
+                raise ValueError(f"batch {B} not divisible by "
+                                 f"{M} pipeline microbatches")
+            layers = [layer_cls(self.h, self.d_model, self.d_ff,
+                                self.dropout_connection_attention,
+                                self.dropout_connection_ffn,
+                                self.dropout_attention, self.dropout_ffn,
+                                self.dtype, self.param_dtype,
+                                self.attention_impl, self.mesh,
+                                self.sp_axis, self.dropout_impl,
+                                remat_ffn, self.fused_qkv, self.ffn_impl,
+                                flash_save_stats, self.quant,
+                                name=f"layer_{i}")
+                      for i in range(self.n_layers)]
+            hs = h.reshape((M, B // M) + h.shape[1:])
+            # per-microbatch view of a batch-carrying mask; a batch-free
+            # causal mask (1,1,L,L) broadcasts into every slot as-is
+            bmask = (mask.reshape((M, B // M) + mask.shape[1:])
+                     if mask is not None and mask.shape[0] == B else None)
+            # fill/drain slots recycle real microbatch data rather than
+            # zeros: their outputs are never selected into the loss
+            # (zero cotangents either way), but an all-zero constant
+            # block lets XLA:CPU constant-fold the slot's attention
+            # backward into 0*inf NaN constants at x64 — recycled data
+            # keeps every slot on the generic (finite) compute path.
+            buf = jnp.broadcast_to(hs[0], (S,) + hs.shape[1:])
+            outs = []
+            for t in range(spec.n_ticks):
+                # rotate: stage s consumes what stage s-1 emitted last
+                # tick (slot 0 takes the next microbatch; drain ticks
+                # recycle microbatch t % M — discarded, see above).
+                # Under GSPMD the pp-sharded dim-0 shift is the
+                # stage-boundary collective-permute — the DCN hop.
+                inp = hs[t % M]
+                buf = jnp.concatenate([inp[None], buf[:-1]], axis=0)
+                buf = constrain_stage_buffer(buf, spec)
+                slots = []
+                for s in range(S):
+                    z = buf[s]
+                    m_ = mask
+                    if bmask is not None:
+                        # the mask of the microbatch in this slot
+                        # (clamped for bubble slots — their output is
+                        # discarded, any finite mask will do)
+                        m_ = bmask[min(max(t - s, 0), M - 1)]
+                    for i in spec.stage_layers[s]:
+                        z = layers[i](z, m_, train)
+                    slots.append(z)
+                buf = jnp.stack(slots)
+                buf = constrain_stage_buffer(buf, spec)
+                if t >= S - 1:
+                    # positive static index: the negative-index gather's
+                    # transpose emits a mixed s64/s32 dynamic_update_slice
+                    # under x64 that the SPMD partitioner rejects
+                    outs.append(buf[S - 1])
+            h = jnp.stack(outs).reshape((B,) + h.shape[1:])
 
         ln = lambda name: TorchLayerNorm(   # noqa: E731
             dtype=self.dtype, param_dtype=self.param_dtype, name=name)

@@ -12,6 +12,11 @@ Axis naming convention used framework-wide:
            ZeRO-3; XLA turns grad psum into reduce_scatter + all_gather)
   "tp"   — tensor parallel (attention heads / MLP hidden sharded)
   "sp"   — sequence/context parallel (ring attention, ops/ring_attention.py)
+  "pp"   — pipeline parallel (encoder LAYERS staged across slices; the
+           stage-boundary activation rotation is the only per-step
+           collective, so pp tolerates the slowest links and is the
+           PREFERRED axis to span DCN on multi-slice pods —
+           parallel/pipeline.py)
 
 AXIS_ALIASES is the ONE canonical alias table (r11 satellite): every
 surface that names a mesh axis — ``--mesh`` parsing, ``resolve_attention``
@@ -42,14 +47,19 @@ AXIS_ALIASES = {
     "fsdp": "fsdp", "zero": "fsdp", "zero3": "fsdp",
     "tp": "tp", "model": "tp", "mp": "tp", "tensor": "tp",
     "sp": "sp", "seq": "sp", "sequence": "sp", "context": "sp",
+    "pp": "pp", "pipe": "pp", "pipeline": "pp", "stage": "pp",
 }
 
 # ICI speed rank for the auto device-assignment policy: higher = placed
 # on a faster (more-minor) mesh axis.  Model/sequence axes carry the
 # per-layer collectives (psum at every FFN/projection boundary, the
 # ring's per-step ppermute), data axes one grad psum per step — so tp
-# gets the fastest links, dp the slowest (DCN on multi-slice pods).
-_AXIS_SPEED = {"dp": 0, "fsdp": 1, "sp": 2, "tp": 3}
+# gets the fastest links, dp the slowest.  pp ranks BELOW dp: a pipeline
+# stage boundary moves one [microbatch, L, d_model] activation per tick
+# point-to-point (collective-permute), the cheapest per-step traffic of
+# any axis, so pp is placed outermost and is the preferred axis to span
+# DCN between slices on multi-slice pods (_ici_device_mesh).
+_AXIS_SPEED = {"pp": -1, "dp": 0, "fsdp": 1, "sp": 2, "tp": 3}
 
 
 def canonical_axis(name: str) -> str:
@@ -79,6 +89,10 @@ def tp_size(mesh: Optional[Mesh]) -> int:
 
 def sp_size(mesh: Optional[Mesh]) -> int:
     return axis_size(mesh, "sp")
+
+
+def pp_size(mesh: Optional[Mesh]) -> int:
+    return axis_size(mesh, "pp")
 
 
 def seq_parallel_axis(mesh: Optional[Mesh]) -> Tuple[Optional[str], int]:
@@ -148,17 +162,22 @@ def _ici_device_mesh(shape: Tuple[int, ...],
     try:
         pc = jax.process_count()
         if pc > 1:
-            # factor the process count out of the slowest DATA axis that
-            # divides it — that axis spans slices over DCN, everything
-            # else stays inside a slice's ICI.  Only dp/fsdp are
-            # eligible: letting tp/sp span DCN would put the per-layer
+            # factor the process count out of the slowest eligible axis
+            # that divides it — that axis spans slices over DCN,
+            # everything else stays inside a slice's ICI.  Eligible:
+            # pp FIRST (it sorts outermost at speed -1 — a stage
+            # boundary moves one point-to-point activation per tick, the
+            # cheapest traffic to put on the slow links), then dp/fsdp
+            # (one grad reduction per step).  tp/sp stay ineligible:
+            # letting them span DCN would put the per-layer
             # model-parallel collectives on the slowest links, inverting
-            # the _AXIS_SPEED policy — a mesh whose data axes can't
+            # the _AXIS_SPEED policy — a mesh whose pp/data axes can't
             # absorb the process count falls back to the plain reshape.
             paxes = [axes[i] for i in perm]
             dcn = [1] * len(pshape)
             for j, d in enumerate(pshape):
-                if paxes[j] in ("dp", "fsdp") and d % pc == 0 and d >= pc:
+                if (paxes[j] in ("pp", "dp", "fsdp")
+                        and d % pc == 0 and d >= pc):
                     dcn[j] = pc
                     break
             else:

@@ -1,0 +1,266 @@
+"""Pipeline parallelism over the pp mesh axis: the ONE rule table.
+
+The third parallelism axis (after dp/fsdp batch sharding and tp/sp
+tensor/sequence sharding): the transformer's L encoder layers are
+partitioned into ``pp`` contiguous STAGES, the batch is split into M
+MICROBATCHES, and the stages process microbatches in a rotating
+schedule — stage s works on microbatch ``t - s`` at tick ``t``, so the
+activation leaving stage s-1 at tick t-1 is exactly what stage s
+consumes at tick t.  The stage-boundary hop is the only per-tick
+communication (a [microbatch, L, d_model] collective-permute over pp),
+which is why pp is the axis that spans DCN between slices
+(parallel/mesh.py::_AXIS_SPEED — pp ranks slowest, placed outermost,
+preferred by the hybrid DCN factoring).
+
+Every routing decision the pipeline makes — stage assignment,
+microbatch count, collective placement, bubble accounting — is decided
+HERE and dumped as one inspectable table (``pipeline_rules``) into the
+run's ``manifest.json`` beside the r15 compile table (cli.run_training),
+in the spirit of SNIPPETS [2]'s ``compile_step_with_plan``: no scattered
+call sites, one place to read what the pipeline did.
+
+Execution model (models/transformer.py, gated on a ``pp_spec`` call
+argument so ``pp=1`` traces stay byte-identical to r21):
+
+  * the [B, L, d] encoder input is reshaped to M microbatches of B/M;
+  * a stage buffer [S, B/M, L, d], sharded ``P("pp", data_axes, ...)``
+    over dim 0, holds each stage's current input;
+  * each of T = M + S - 1 ticks rotates the buffer down one stage
+    (the collective-permute), inserts the next microbatch at stage 0,
+    and applies every stage's layer block to its slot;
+  * the last stage's outputs are collected in microbatch order and
+    reassembled into [B, L, d] — bitwise the same VALUES as running the
+    microbatches sequentially, so the pp=2 ≡ pp=1 comparison sits in
+    the documented cross-program-family allclose class (batch-dim
+    tiling + microbatch reduction order), while within a pp program
+    family everything stays bitwise (the r8 scan-rounding precedent).
+
+The schedule is 1F1B in the combined fwd+bwd sense: jax.grad
+differentiates through the rotation, so the backward pipeline replays
+the ticks in reverse — stage s's backward for microbatch m runs as soon
+as stage s+1's has (the reversed rotation), one-forward-one-backward
+per stage per tick with no GPipe-style full-forward buffer beyond the
+[S, ...] stage buffer itself.  ``schedule="interleaved"`` changes only
+the stage ASSIGNMENT (round-robin layer chunks, v-interleaving) — the
+tick loop is identical; the rule table records which was used.
+
+Fill/drain ticks (the bubble) compute on recycled microbatch data
+(never zeros — an all-zero constant block invites XLA constant-folding
+the slot's backward into 0*inf NaN constants at x64): the garbage
+outputs are never selected into the loss, so their cotangents are zero
+and the extra work is exactly the analytic bubble fraction
+(S - 1) / (M + S - 1) — the executed program genuinely pays the bubble
+it reports (``pipeline_bubble_pct``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+from faster_distributed_training_tpu.parallel.mesh import pp_size
+
+SCHEDULES = ("1f1b", "interleaved")
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineSpec:
+    """Static description of one pipelined encoder — everything the
+    traced program and the rule table need.  ``mesh`` rides along (not
+    part of equality-relevant identity: specs are rebuilt per Trainer,
+    never hashed into jit keys — the pp program is selected by python
+    branching before trace)."""
+    n_layers: int
+    n_stages: int
+    n_microbatches: int
+    stage_layers: Tuple[Tuple[int, ...], ...]   # layer indices per stage
+    schedule: str = "1f1b"
+    mesh: Optional[object] = None
+
+    @property
+    def n_ticks(self) -> int:
+        return self.n_microbatches + self.n_stages - 1
+
+    @property
+    def bubble_pct(self) -> float:
+        return 100.0 * bubble_fraction(self.n_stages, self.n_microbatches)
+
+
+def partition_stages(n_layers: int, n_stages: int,
+                     schedule: str = "1f1b"
+                     ) -> Tuple[Tuple[int, ...], ...]:
+    """Layer indices per stage.
+
+    "1f1b": contiguous balanced blocks — earlier stages take the extra
+    layer when n_layers % n_stages != 0 (they also host the un-staged
+    embedding, but the tie-break is mostly cosmetic: the schedule's
+    critical path is the max per-stage block either way).
+
+    "interleaved": layers dealt round-robin in contiguous CHUNKS of
+    ceil(L / (S * v)) with v=2 virtual stages per physical stage where
+    the layer count allows (the Megatron v-interleave) — each stage
+    touches two non-adjacent regions of the depth, halving the bubble's
+    dependence on per-stage depth at the price of twice the boundary
+    hops.  Falls back to the contiguous split when L < 2 * S."""
+    if not 1 <= n_stages <= n_layers:
+        raise ValueError(f"cannot split {n_layers} layers into "
+                         f"{n_stages} pipeline stages")
+    if schedule not in SCHEDULES:
+        raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                         f"(one of {SCHEDULES})")
+    if schedule == "interleaved" and n_layers >= 2 * n_stages:
+        v = 2
+        chunk = -(-n_layers // (n_stages * v))
+        chunks = [tuple(range(i, min(i + chunk, n_layers)))
+                  for i in range(0, n_layers, chunk)]
+        out = [[] for _ in range(n_stages)]
+        for idx, ch in enumerate(chunks):
+            out[idx % n_stages].extend(ch)
+        return tuple(tuple(s) for s in out)
+    base, extra = divmod(n_layers, n_stages)
+    bounds, lo = [], 0
+    for s in range(n_stages):
+        hi = lo + base + (1 if s < extra else 0)
+        bounds.append(tuple(range(lo, hi)))
+        lo = hi
+    return tuple(bounds)
+
+
+def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
+    """Idle fraction of the pipelined dispatch: (S-1)/(M+S-1).  Each
+    stage is active for exactly M of the T = M+S-1 ticks (fill for the
+    early stages' tail, drain for the late stages' head)."""
+    if n_stages <= 1:
+        return 0.0
+    return (n_stages - 1) / float(n_microbatches + n_stages - 1)
+
+
+def schedule_ticks(n_stages: int, n_microbatches: int
+                   ) -> Tuple[Tuple[Tuple[int, int], ...], ...]:
+    """The schedule as data, for tests/telemetry: per tick, the active
+    (stage, microbatch) pairs.  Stage s processes microbatch t-s when
+    0 <= t-s < M; everything else is a bubble slot."""
+    out = []
+    for t in range(n_microbatches + n_stages - 1):
+        out.append(tuple((s, t - s) for s in range(n_stages)
+                         if 0 <= t - s < n_microbatches))
+    return tuple(out)
+
+
+def stage_idle_ticks(spec: PipelineSpec) -> Tuple[int, ...]:
+    """Bubble ticks per stage (each stage idles exactly S-1 of the T
+    ticks under the rotation schedule) — the per-stage accounting the
+    ``pp_stage`` telemetry records and the ``pp_stage_idle_ms`` bench
+    arm scales by the measured tick time."""
+    return tuple(spec.n_ticks - spec.n_microbatches
+                 for _ in range(spec.n_stages))
+
+
+def resolve_microbatches(batch_size: int, n_stages: int,
+                         requested: int = 0) -> int:
+    """Microbatch count M for a global batch: the requested value when
+    given (must divide the batch), else the largest divisor of
+    batch_size in [S, 2S] — 2S halves the bubble vs M=S, and staying a
+    divisor keeps every microbatch the same shape (one compiled stage
+    program, no ragged tail).  Falls back toward S, then to the largest
+    divisor <= batch_size."""
+    if requested:
+        if batch_size % requested:
+            raise ValueError(
+                f"--pp_microbatches {requested} does not divide the "
+                f"global batch {batch_size}")
+        return requested
+    for m in range(2 * n_stages, n_stages - 1, -1):
+        if m and batch_size % m == 0:
+            return m
+    for m in range(min(n_stages, batch_size), 0, -1):
+        if batch_size % m == 0:
+            return m
+    return 1
+
+
+def build_pipeline_spec(cfg, mesh) -> Optional[PipelineSpec]:
+    """The spec for this (cfg, mesh), or None when the mesh has no pp
+    axis of size > 1 — the None path is what keeps pp=1 programs
+    byte-identical (callers select today's unstaged code path on None,
+    they never trace a degenerate 1-stage pipeline)."""
+    stages = pp_size(mesh)
+    if stages <= 1:
+        return None
+    if cfg.model != "transformer":
+        raise ValueError(
+            f"--mesh with pp={stages}: pipeline parallelism stages the "
+            f"transformer encoder; model {cfg.model!r} has no staged "
+            f"form")
+    if (getattr(cfg, "quant", "none") or "none") != "none":
+        # each layer's QuantDense amax history would roll once per TICK
+        # instead of once per step under the staged encoder, silently
+        # changing the delayed-scaling semantics vs pp=1 — refuse
+        # loudly; named ROADMAP follow-on next to the decode
+        # unquantized-checkpoint caveat.
+        raise ValueError(
+            f"--quant {cfg.quant} does not compose with pipeline "
+            f"parallelism yet (per-tick amax updates would diverge from "
+            f"the pp=1 delayed-scaling schedule); train unquantized on "
+            f"pp meshes")
+    schedule = getattr(cfg, "pp_schedule", "1f1b") or "1f1b"
+    m = resolve_microbatches(cfg.batch_size, stages,
+                             int(getattr(cfg, "pp_microbatches", 0) or 0))
+    return PipelineSpec(
+        n_layers=cfg.n_layers, n_stages=stages, n_microbatches=m,
+        stage_layers=partition_stages(cfg.n_layers, stages, schedule),
+        schedule=schedule, mesh=mesh)
+
+
+def constrain_stage_buffer(buf, spec: PipelineSpec):
+    """The pipeline's single placement rule, applied to the [S, mb, L,
+    d] stage buffer: dim 0 over pp (each stage's slot lives on its
+    slice — the rotation becomes the DCN collective-permute), dim 1
+    over the data axes (microbatches stay batch-sharded within a
+    slice).  tp/sp activation constraints keep applying INSIDE the
+    layers unchanged."""
+    from faster_distributed_training_tpu.parallel.sharding import (
+        shard_activation)
+    return shard_activation(
+        buf, spec.mesh,
+        ("pp", ("dp", "fsdp")) + (None,) * (buf.ndim - 2))
+
+
+def pipeline_rules(spec: Optional[PipelineSpec], cfg=None) -> dict:
+    """The inspectable routing/rule table dumped into manifest.json
+    beside the compile table (cli.run_training) — stage assignment,
+    microbatch count, collective placement and bubble accounting in one
+    place, so "what did the pipeline decide" is a file read, not a
+    code trace."""
+    if spec is None:
+        return {"enabled": False, "n_stages": 1}
+    return {
+        "enabled": True,
+        "schedule": spec.schedule,
+        "n_stages": spec.n_stages,
+        "n_layers": spec.n_layers,
+        "n_microbatches": spec.n_microbatches,
+        "n_ticks": spec.n_ticks,
+        "bubble_pct": round(spec.bubble_pct, 3),
+        "stage_idle_ticks": list(stage_idle_ticks(spec)),
+        "stages": [
+            {"stage": s,
+             "layers": [f"layer_{i}" for i in layers],
+             # embedding/head are un-staged (replicated over pp, like
+             # every param — see param_placement below); the table
+             # records their logical home for the memory follow-on
+             "extra": (["embeddings"] if s == 0 else [])
+             + (["ln_final", "head"] if s == spec.n_stages - 1 else [])}
+            for s, layers in enumerate(spec.stage_layers)],
+        # placement rules, verbatim what the traced program constrains:
+        "activation_placement":
+            "stage buffer [S, B/M, L, d] = P('pp', ('dp','fsdp'))",
+        "boundary_collective":
+            "collective-permute over pp (the DCN hop), one "
+            "[B/M, L, d] activation per tick",
+        "param_placement":
+            "replicated over pp (dp/fsdp/tp/zero specs unchanged per "
+            "stage — physical per-stage residency is the named "
+            "live-TPU ROADMAP follow-on)",
+        "batch_axes": "dp/fsdp only (pp never shards the batch)",
+    }

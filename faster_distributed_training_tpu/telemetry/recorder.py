@@ -199,6 +199,17 @@ TELEMETRY_SCHEMA: Dict[str, Optional[frozenset]] = {
     "decode_step": frozenset({"replica", "pages", "active", "batch",
                               "step_ms"}),
     "slot_evict": frozenset({"replica", "slot", "tokens", "reason"}),
+    # r22 pipeline parallelism (parallel/pipeline.py; emitted once at
+    # startup by cli.run_training on pp>1 meshes) — append-only: one
+    # pp_bubble with the schedule's analytic accounting (the executed
+    # program pays exactly this — fill/drain ticks compute on zero
+    # microbatches), one pp_stage per stage with its layer block and
+    # idle/active tick split (what pp_stage_idle_ms scales by measured
+    # tick time)
+    "pp_bubble": frozenset({"n_stages", "n_microbatches", "n_ticks",
+                            "schedule", "bubble_pct"}),
+    "pp_stage": frozenset({"stage", "layers", "idle_ticks",
+                           "active_ticks"}),
 }
 # kinds that once existed but are no longer emitted (none today): the
 # lint's staleness rule consults this instead of forcing removal from

@@ -73,8 +73,14 @@ class Trainer:
                  log: Callable[[str], None] = print,
                  state_shardings=None, resilience=None,
                  put_stacked: Optional[Callable] = None, resident=None,
-                 telemetry=None, profiler=None, stream=None):
+                 telemetry=None, profiler=None, stream=None,
+                 pipeline=None):
         self.cfg = cfg
+        # parallel.pipeline.PipelineSpec on a pp>1 mesh (None everywhere
+        # else): threads into every train-step build so the forward runs
+        # the staged 1F1B microbatch rotation; eval stays unstaged (the
+        # params are identical, pp only reorders the encoder's work)
+        self.pipeline = pipeline
         # telemetry.RunTelemetry bundle (or None = zero hot-path
         # overhead): per-dispatch JSONL records, span breakdown, epoch
         # pod aggregation + straggler flags — telemetry/__init__.py
@@ -133,7 +139,8 @@ class Trainer:
                              if telemetry is not None else None)
         self.train_step = self._observe(
             "train:host:k1",
-            jax.jit(make_train_step(cfg, state_shardings), **donate),
+            jax.jit(make_train_step(cfg, state_shardings,
+                                    pipeline=pipeline), **donate),
             sig_argnums=(1,))
         self._fused_cache: Dict[tuple, Callable] = {}
         # sig_argnums=(1,): eval batches legally vary (text bucket
@@ -196,7 +203,8 @@ class Trainer:
             mesh = getattr(resident, "mesh", None)
             fn = jax.jit(
                 make_fused_train_step(self.cfg, kk, self._state_shardings,
-                                      resident=resident, mesh=mesh),
+                                      resident=resident, mesh=mesh,
+                                      pipeline=self.pipeline),
                 **self._donate)
             # resident signature args: the per-epoch data/order arrays
             # and the start scalar (a regression to a python-int start

@@ -107,7 +107,7 @@ def _offload_transfers(state_shardings):
     return fetch, stash
 
 
-def make_train_step(cfg: TrainConfig, state_shardings=None
+def make_train_step(cfg: TrainConfig, state_shardings=None, pipeline=None
                     ) -> Callable[[TrainState, Any],
                                   Tuple[TrainState, Metrics]]:
     """Build the jitted train step for cfg.model ('resnet*' or 'transformer').
@@ -125,7 +125,12 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
     resume"), and (b) the fused K-step dispatch can advance the stream
     on device with zero host involvement.  Pre-normalized float batches
     (bench/synthetic probes, the eval staging path) pass through
-    untouched."""
+    untouched.
+
+    pipeline: a parallel.pipeline.PipelineSpec on a pp>1 mesh — the
+    transformer forward then runs the staged 1F1B microbatch rotation
+    (models/transformer.py).  None (every pp=1 config) adds NOTHING to
+    the apply call, so those programs stay byte-identical to r21."""
     fp16 = cfg.precision == "fp16"
     is_text = cfg.model == "transformer"
     lm = getattr(cfg, "task", "cls") == "lm"
@@ -180,6 +185,9 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
     # the augmentation stream root — the same seed+1 derivation
     # cli.run_training used for the host-counter stream it replaces
     aug_root = jax.random.PRNGKey(cfg.seed + 1)
+    # pp>1 only: the staged-encoder selector, absent (not None-valued —
+    # ABSENT) from every pp=1 apply call so those traces don't change
+    pp_kwargs = {} if pipeline is None else {"pp_spec": pipeline}
 
     def step(state: TrainState, batch: Dict[str, jax.Array]
              ) -> Tuple[TrainState, Metrics]:
@@ -228,7 +236,7 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
                     token_types=batch.get("token_types"),
                     mask=None, train=True,
                     rngs={"dropout": k_drop, "mixup": k_mix},
-                    mutable=["batch_stats"])
+                    mutable=["batch_stats"], **pp_kwargs)
                 loss_total, correct, total = lm_shift_metrics(
                     logits, batch["tokens"], batch.get("mask"))
                 loss = loss_total / jnp.maximum(total, 1.0)
@@ -275,7 +283,7 @@ def make_train_step(cfg: TrainConfig, state_shardings=None
                     token_types=batch.get("token_types"),
                     mask=batch.get("mask"), train=True,
                     rngs={"dropout": k_drop, "mixup": k_mix},
-                    mutable=["batch_stats"])
+                    mutable=["batch_stats"], **pp_kwargs)
                 logits, index, lam = out       # in-forward mixup triplet
                 y_a, y_b = y, y[index]
                 loss = mx.mixup_criterion(cross_entropy, logits, y_a, y_b,
@@ -369,7 +377,8 @@ def _reduce_scanned_metrics(ms: Metrics) -> Metrics:
 
 
 def make_fused_train_step(cfg: TrainConfig, k: int, state_shardings=None,
-                          resident=None, mesh=None) -> Callable:
+                          resident=None, mesh=None,
+                          pipeline=None) -> Callable:
     """K steps in ONE device dispatch: ``lax.scan`` over the single-step
     body (Kumar et al. 2021's loop-inside-the-program fix for dispatch-
     bound small-model training).  The scan compiles the body ONCE and
@@ -402,8 +411,15 @@ def make_fused_train_step(cfg: TrainConfig, k: int, state_shardings=None,
 
     k == 1 is valid (one-step scan) but the Trainer keeps the plain
     ``make_train_step`` path for it — the default behavior stays
-    byte-for-byte today's."""
-    base = make_train_step(cfg, state_shardings)
+    byte-for-byte today's.
+
+    pipeline (r22): on a pp>1 mesh the scan BODY is the staged
+    1F1B-microbatched step, so the pipeline's tick loop nests inside
+    the K-dispatch scan — the pipeline bubble and the K-ladder share
+    one dispatch accounting (the donated carry, the exact stacked-
+    metric reduction and the loss-scale/NGD/mixup threading are the
+    scan's, unchanged)."""
+    base = make_train_step(cfg, state_shardings, pipeline=pipeline)
     k = int(k)
     if k < 1:
         raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")

@@ -519,6 +519,11 @@ class TestE2ETrain2D:
         _tree_allclose(got["state"].params, ref["state"].params,
                        rtol=5e-4, atol=1e-6)
 
+    @pytest.mark.slow  # r22 budget diet: 11 s — tier-1 keeps the K=4
+    # twin WITH the quant kernels (test_fused_dispatch_k4_twins_k1_quant
+    # below exercises the same shard_map layer + scan composition, and
+    # its grid-step bound is the standing ROADMAP pin) and the 2D K-twin
+    # in test_mesh2d; the flash-only variant runs in the slow tier
     def test_fused_dispatch_k4_twins_k1_flash(self, tmp_path):
         """K=4 vs K=1 with the head-sharded flash kernel on — same
         mesh, same kernels, the r8 contract at the r11 2D pin: the scan

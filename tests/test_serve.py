@@ -408,6 +408,10 @@ def test_serve_smoke_in_process(trained_dir, smoke_mod, capsys):
 
 # -- tp>1 model-sharded serving (the SNIPPETS [3] fallback path) -----------
 
+@pytest.mark.slow  # r22 budget diet: 31 s — tier-1 keeps tp-sharded
+# MATH parity (test_mesh2d's dp4×tp2 e2e + sharding-spec asserts), the
+# serving machinery itself (scheduler/replica/AOT tests above), and the
+# decode program-set pin; the tp=2 serve twin runs in the slow tier
 def test_tp2_mesh_serving_matches_1d_replica(trained_dir, smoke_mod):
     """End-to-end tp=2 serving for the classifier path: the SAME
     ragged request mix through (a) the default replicated-per-chip
