@@ -1076,7 +1076,10 @@ def run_training(cfg: TrainConfig,
                     layers=[f"layer_{i}"
                             for i in pipeline.stage_layers[s]],
                     idle_ticks=idle,
-                    active_ticks=pipeline.n_microbatches)
+                    # slot-tick units, matching idle_ticks: M per slot
+                    # x V/S slots per stage (== M for 1f1b)
+                    active_ticks=pipeline.n_microbatches
+                    * (pipeline.n_virtual // pipeline.n_stages))
         if res is not None:
             # restart/preemption/peer-failure counters land in the
             # stream as they happen (goodput.set_event_sink)

@@ -59,8 +59,12 @@ class TrainConfig:
                                       # --batch_size when set
     pp_schedule: str = "1f1b"         # 1f1b (contiguous stages) |
                                       # interleaved (round-robin layer
-                                      # chunks, v=2) — stage ASSIGNMENT
-                                      # only; the tick loop is shared
+                                      # chunks, v=2; needs L % 2S == 0,
+                                      # else contiguous fallback) — the
+                                      # tick loop always traverses the
+                                      # chunks in DEPTH order, so both
+                                      # schedules compute the pp=1
+                                      # function (pipeline.py)
 
     # -- optimization (reference flag surface) ----------------------------
     lr: float = 0.1
@@ -780,8 +784,10 @@ def build_parser(prog: str = "fdt",
                    choices=["1f1b", "interleaved"],
                    help="pipeline stage assignment: 1f1b = contiguous "
                         "layer blocks; interleaved = round-robin chunks "
-                        "(v=2 virtual stages per stage where the depth "
-                        "allows)")
+                        "(Megatron v=2, requires n_layers %% (2*pp) == "
+                        "0, contiguous fallback otherwise) — executed "
+                        "in depth order either way, at the price of a "
+                        "longer fill/drain (bubble (2S-1)/(M+2S-1))")
     p.add_argument("--stream_dir", default=d.stream_dir, type=str,
                    help="sharded stream dataset root (train/ + test/ "
                         "subdirs; scripts/shard_dataset.py writes one) — "

@@ -834,13 +834,24 @@ class Transformer(nn.Module):
             # branch above) stay byte-identical to r21.  Same modules,
             # same names, same param tree: only the execution order of
             # the layer applications changes — the batch runs as M
-            # microbatches through S rotating stage slots, and jax.grad
-            # through the rotation yields the reversed (1F1B) backward
-            # pipeline.
+            # microbatches through V rotating virtual-stage slots, and
+            # jax.grad through the rotation yields the reversed (1F1B)
+            # backward pipeline.  With dropout LIVE the per-tick layer
+            # invocations draw a different make_rng stream than the
+            # unstaged forward (bubble slots included), so the pp ≡
+            # pp=1 parity class requires dropout disabled —
+            # build_pipeline_spec warns (pipeline.py docstring).
             from faster_distributed_training_tpu.parallel.pipeline import (
-                constrain_stage_buffer)
+                constrain_stage_buffer, virtual_chunks)
             spec = pp_spec
-            M, S = spec.n_microbatches, spec.n_stages
+            # the tick loop runs the depth-ordered VIRTUAL chunks, not
+            # a stage's concatenated layer list: slot j applies chunk j
+            # (chunks ordered by first layer, pipeline.virtual_chunks),
+            # so a microbatch traverses layer 0..L-1 in order under
+            # EVERY schedule — 1f1b (V == S, one chunk per stage) and
+            # v=2 interleaved (V == 2S, stage j % S hosts slot j) alike.
+            chunks = virtual_chunks(spec)
+            M, V = spec.n_microbatches, len(chunks)
             if B % M:
                 raise ValueError(f"batch {B} not divisible by "
                                  f"{M} pipeline microbatches")
@@ -866,36 +877,36 @@ class Transformer(nn.Module):
             # block lets XLA:CPU constant-fold the slot's attention
             # backward into 0*inf NaN constants at x64 — recycled data
             # keeps every slot on the generic (finite) compute path.
-            buf = jnp.broadcast_to(hs[0], (S,) + hs.shape[1:])
+            buf = jnp.broadcast_to(hs[0], (V,) + hs.shape[1:])
             outs = []
             for t in range(spec.n_ticks):
-                # rotate: stage s consumes what stage s-1 emitted last
+                # rotate: slot j consumes what slot j-1 emitted last
                 # tick (slot 0 takes the next microbatch; drain ticks
                 # recycle microbatch t % M — discarded, see above).
-                # Under GSPMD the pp-sharded dim-0 shift is the
+                # Under GSPMD the pp-sharded slot-dim shift is the
                 # stage-boundary collective-permute — the DCN hop.
                 inp = hs[t % M]
                 buf = jnp.concatenate([inp[None], buf[:-1]], axis=0)
                 buf = constrain_stage_buffer(buf, spec)
                 slots = []
-                for s in range(S):
-                    z = buf[s]
+                for j in range(V):
+                    z = buf[j]
                     m_ = mask
                     if bmask is not None:
                         # the mask of the microbatch in this slot
                         # (clamped for bubble slots — their output is
                         # discarded, any finite mask will do)
-                        m_ = bmask[min(max(t - s, 0), M - 1)]
-                    for i in spec.stage_layers[s]:
+                        m_ = bmask[min(max(t - j, 0), M - 1)]
+                    for i in chunks[j]:
                         z = layers[i](z, m_, train)
                     slots.append(z)
                 buf = jnp.stack(slots)
                 buf = constrain_stage_buffer(buf, spec)
-                if t >= S - 1:
+                if t >= V - 1:
                     # positive static index: the negative-index gather's
                     # transpose emits a mixed s64/s32 dynamic_update_slice
                     # under x64 that the SPMD partitioner rejects
-                    outs.append(buf[S - 1])
+                    outs.append(buf[V - 1])
             h = jnp.stack(outs).reshape((B,) + h.shape[1:])
 
         ln = lambda name: TorchLayerNorm(   # noqa: E731

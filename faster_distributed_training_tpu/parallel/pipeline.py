@@ -34,23 +34,42 @@ argument so ``pp=1`` traces stay byte-identical to r21):
     the documented cross-program-family allclose class (batch-dim
     tiling + microbatch reduction order), while within a pp program
     family everything stays bitwise (the r8 scan-rounding precedent).
+    The parity contract holds with DROPOUT DISABLED only: under the
+    staged encoder each layer is invoked once per tick (bubble slots
+    included), so Flax's make_rng fold count differs from the unstaged
+    forward and bubble slots consume dropout draws — still valid
+    dropout (an independent mask stream), but a different stream than
+    pp=1, so pp=2 vs pp=1 is not comparable beyond distribution.
+    build_pipeline_spec warns when pp>1 meets a live dropout impl.
 
 The schedule is 1F1B in the combined fwd+bwd sense: jax.grad
 differentiates through the rotation, so the backward pipeline replays
 the ticks in reverse — stage s's backward for microbatch m runs as soon
 as stage s+1's has (the reversed rotation), one-forward-one-backward
 per stage per tick with no GPipe-style full-forward buffer beyond the
-[S, ...] stage buffer itself.  ``schedule="interleaved"`` changes only
-the stage ASSIGNMENT (round-robin layer chunks, v-interleaving) — the
-tick loop is identical; the rule table records which was used.
+[S, ...] stage buffer itself.  ``schedule="interleaved"`` (the
+Megatron v=2 assignment) deals round-robin layer chunks to the stages
+and the tick loop traverses the resulting VIRTUAL stages in depth
+order: the buffer grows to V = 2S slots, slot j applies depth-chunk j
+(``virtual_chunks`` is the contract), and physical stage j % S hosts
+slot j — so every microbatch still applies layer 0..L-1 in order and
+the pp=2 ≡ pp=1 parity class is schedule-independent.  In this
+rotate-all formulation each tick computes ALL of a stage's chunks, so
+interleaving buys placement fidelity (two non-adjacent depth regions
+per stage, twice the boundary hops), NOT the Megatron bubble win:
+fill/drain lengthens to V-1 ticks and the rule table records the
+honest (V-1)/(M+V-1).  The chunk-granularity staggered schedule that
+realizes the v× bubble reduction is a named live-TPU ROADMAP
+follow-on.
 
 Fill/drain ticks (the bubble) compute on recycled microbatch data
 (never zeros — an all-zero constant block invites XLA constant-folding
 the slot's backward into 0*inf NaN constants at x64): the garbage
 outputs are never selected into the loss, so their cotangents are zero
 and the extra work is exactly the analytic bubble fraction
-(S - 1) / (M + S - 1) — the executed program genuinely pays the bubble
-it reports (``pipeline_bubble_pct``).
+(V - 1) / (M + V - 1) over the V virtual-stage slots (V = S for 1f1b)
+— the executed program genuinely pays the bubble it reports
+(``pipeline_bubble_pct``).
 """
 
 from __future__ import annotations
@@ -78,12 +97,20 @@ class PipelineSpec:
     mesh: Optional[object] = None
 
     @property
+    def n_virtual(self) -> int:
+        """Virtual-stage count V: the number of depth-ordered chunks
+        the tick loop traverses (== n_stages for contiguous 1F1B
+        assignment, 2 * n_stages under v=2 interleaving)."""
+        return len(virtual_chunks(self))
+
+    @property
     def n_ticks(self) -> int:
-        return self.n_microbatches + self.n_stages - 1
+        return self.n_microbatches + self.n_virtual - 1
 
     @property
     def bubble_pct(self) -> float:
-        return 100.0 * bubble_fraction(self.n_stages, self.n_microbatches)
+        return 100.0 * bubble_fraction(self.n_virtual,
+                                       self.n_microbatches)
 
 
 def partition_stages(n_layers: int, n_stages: int,
@@ -97,21 +124,25 @@ def partition_stages(n_layers: int, n_stages: int,
     critical path is the max per-stage block either way).
 
     "interleaved": layers dealt round-robin in contiguous CHUNKS of
-    ceil(L / (S * v)) with v=2 virtual stages per physical stage where
-    the layer count allows (the Megatron v-interleave) — each stage
-    touches two non-adjacent regions of the depth, halving the bubble's
-    dependence on per-stage depth at the price of twice the boundary
-    hops.  Falls back to the contiguous split when L < 2 * S."""
+    L / (S * v) with v=2 virtual stages per physical stage (the
+    Megatron v-interleave ASSIGNMENT) — each stage touches two
+    non-adjacent regions of the depth at the price of twice the
+    boundary hops.  Requires L % (2S) == 0 so the V = 2S depth-ordered
+    chunks are equal-sized and slot j lands on stage j % S exactly
+    (the placement rule constrain_stage_buffer encodes); falls back to
+    the contiguous split otherwise.  Execution stays depth-ordered
+    either way: the tick loop runs virtual_chunks, never a stage's
+    concatenated layer list."""
     if not 1 <= n_stages <= n_layers:
         raise ValueError(f"cannot split {n_layers} layers into "
                          f"{n_stages} pipeline stages")
     if schedule not in SCHEDULES:
         raise ValueError(f"unknown pipeline schedule {schedule!r} "
                          f"(one of {SCHEDULES})")
-    if schedule == "interleaved" and n_layers >= 2 * n_stages:
+    if schedule == "interleaved" and n_layers % (2 * n_stages) == 0:
         v = 2
-        chunk = -(-n_layers // (n_stages * v))
-        chunks = [tuple(range(i, min(i + chunk, n_layers)))
+        chunk = n_layers // (n_stages * v)
+        chunks = [tuple(range(i, i + chunk))
                   for i in range(0, n_layers, chunk)]
         out = [[] for _ in range(n_stages)]
         for idx, ch in enumerate(chunks):
@@ -126,13 +157,40 @@ def partition_stages(n_layers: int, n_stages: int,
     return tuple(bounds)
 
 
-def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
-    """Idle fraction of the pipelined dispatch: (S-1)/(M+S-1).  Each
-    stage is active for exactly M of the T = M+S-1 ticks (fill for the
-    early stages' tail, drain for the late stages' head)."""
-    if n_stages <= 1:
+def virtual_chunks(spec: PipelineSpec) -> Tuple[Tuple[int, ...], ...]:
+    """The depth-ordered virtual-stage chunks the tick loop executes:
+    each chunk is a maximal run of consecutive layers from one stage's
+    assignment, and the chunks are ordered by first layer — so slot j
+    applying chunk j walks every microbatch through layer 0..L-1 in
+    depth order REGARDLESS of schedule (the property the pp ≡ pp=1
+    parity pins).  Contiguous 1F1B assignment yields one run per stage
+    (chunks == stage_layers, V == S); v=2 interleaving yields V == 2S
+    equal runs with chunk j owned by stage j % S — the mapping
+    constrain_stage_buffer's [v, S] placement view relies on."""
+    runs = []
+    for layers in spec.stage_layers:
+        start = 0
+        for k in range(1, len(layers) + 1):
+            if k == len(layers) or layers[k] != layers[k - 1] + 1:
+                runs.append(tuple(layers[start:k]))
+                start = k
+    runs.sort(key=lambda r: r[0])
+    flat = [i for r in runs for i in r]
+    if flat != sorted(flat):
+        raise ValueError(f"stage assignment {spec.stage_layers} has "
+                         f"overlapping depth runs — no depth-ordered "
+                         f"traversal exists")
+    return tuple(runs)
+
+
+def bubble_fraction(n_slots: int, n_microbatches: int) -> float:
+    """Idle fraction of the pipelined dispatch: (V-1)/(M+V-1) over the
+    V virtual-stage slots (V == S for 1f1b).  Each slot is active for
+    exactly M of the T = M+V-1 ticks (fill for the early slots' tail,
+    drain for the late slots' head)."""
+    if n_slots <= 1:
         return 0.0
-    return (n_stages - 1) / float(n_microbatches + n_stages - 1)
+    return (n_slots - 1) / float(n_microbatches + n_slots - 1)
 
 
 def schedule_ticks(n_stages: int, n_microbatches: int
@@ -148,11 +206,15 @@ def schedule_ticks(n_stages: int, n_microbatches: int
 
 
 def stage_idle_ticks(spec: PipelineSpec) -> Tuple[int, ...]:
-    """Bubble ticks per stage (each stage idles exactly S-1 of the T
-    ticks under the rotation schedule) — the per-stage accounting the
+    """Bubble slot-ticks per stage — the per-stage accounting the
     ``pp_stage`` telemetry records and the ``pp_stage_idle_ms`` bench
-    arm scales by the measured tick time."""
-    return tuple(spec.n_ticks - spec.n_microbatches
+    arm scales by the measured tick time.  Each of a stage's V/S slots
+    idles exactly V-1 = T-M of the T ticks under the rotation
+    schedule, so a stage's idle total is (V/S)(V-1): S-1 for 1f1b,
+    2(2S-1) under v=2 interleaving (the lengthened fill/drain the
+    module docstring owns up to)."""
+    slots_per_stage = spec.n_virtual // spec.n_stages
+    return tuple(slots_per_stage * (spec.n_ticks - spec.n_microbatches)
                  for _ in range(spec.n_stages))
 
 
@@ -165,6 +227,13 @@ def resolve_microbatches(batch_size: int, n_stages: int,
     program, no ragged tail).  Falls back toward S, then to the largest
     divisor <= batch_size."""
     if requested:
+        # validate the range BEFORE the divisibility check: python's
+        # `8 % -2 == 0`, so a negative count would sail through and
+        # surface as an obscure reshape/trace failure far from the flag
+        if not 1 <= requested <= batch_size:
+            raise ValueError(
+                f"--pp_microbatches {requested} must be in "
+                f"[1, batch_size={batch_size}]")
         if batch_size % requested:
             raise ValueError(
                 f"--pp_microbatches {requested} does not divide the "
@@ -203,6 +272,20 @@ def build_pipeline_spec(cfg, mesh) -> Optional[PipelineSpec]:
             f"parallelism yet (per-tick amax updates would diverge from "
             f"the pp=1 delayed-scaling schedule); train unquantized on "
             f"pp meshes")
+    if (getattr(cfg, "dropout_impl", "none") or "none") != "none":
+        # dropout stays VALID on a pp mesh (an independent mask
+        # stream), but the staged encoder's make_rng fold count differs
+        # from pp=1 and bubble slots consume draws — so pp>1 vs pp=1
+        # runs are only comparable in distribution, not the documented
+        # allclose class (module docstring).  Warn, don't refuse.
+        import warnings
+        warnings.warn(
+            f"pp={stages} with dropout_impl={cfg.dropout_impl!r}: the "
+            f"staged encoder draws a different dropout stream than "
+            f"pp=1 (per-tick make_rng folds, bubble-slot draws) — the "
+            f"pp ≡ pp=1 parity contract holds only with dropout "
+            f"disabled (--dropout_impl none)",
+            stacklevel=2)
     schedule = getattr(cfg, "pp_schedule", "1f1b") or "1f1b"
     m = resolve_microbatches(cfg.batch_size, stages,
                              int(getattr(cfg, "pp_microbatches", 0) or 0))
@@ -213,17 +296,31 @@ def build_pipeline_spec(cfg, mesh) -> Optional[PipelineSpec]:
 
 
 def constrain_stage_buffer(buf, spec: PipelineSpec):
-    """The pipeline's single placement rule, applied to the [S, mb, L,
-    d] stage buffer: dim 0 over pp (each stage's slot lives on its
-    slice — the rotation becomes the DCN collective-permute), dim 1
-    over the data axes (microbatches stay batch-sharded within a
-    slice).  tp/sp activation constraints keep applying INSIDE the
-    layers unchanged."""
+    """The pipeline's single placement rule, applied to the [V, mb, L,
+    d] stage buffer: the slot dim over pp (each stage's slots live on
+    its slice — the rotation becomes the DCN collective-permute), the
+    microbatch dim over the data axes (microbatches stay batch-sharded
+    within a slice).  tp/sp activation constraints keep applying
+    INSIDE the layers unchanged.
+
+    With V == S (1f1b) dim 0 shards over pp directly.  Under v=2
+    interleaving (V == 2S, depth-ordered slots, chunk j owned by stage
+    j % S) a contiguous dim-0 shard would pile adjacent chunks onto
+    one stage, so the buffer is viewed as [v, S, mb, ...] — the STAGE
+    dim shards over pp, placing slot j = p*S + s on stage s = j % S,
+    exactly the round-robin assignment the rule table records."""
     from faster_distributed_training_tpu.parallel.sharding import (
         shard_activation)
-    return shard_activation(
-        buf, spec.mesh,
-        ("pp", ("dp", "fsdp")) + (None,) * (buf.ndim - 2))
+    V, S = buf.shape[0], spec.n_stages
+    if V == S:
+        return shard_activation(
+            buf, spec.mesh,
+            ("pp", ("dp", "fsdp")) + (None,) * (buf.ndim - 2))
+    grouped = buf.reshape((V // S, S) + buf.shape[1:])
+    grouped = shard_activation(
+        grouped, spec.mesh,
+        (None, "pp", ("dp", "fsdp")) + (None,) * (buf.ndim - 2))
+    return grouped.reshape(buf.shape)
 
 
 def pipeline_rules(spec: Optional[PipelineSpec], cfg=None) -> dict:
@@ -240,9 +337,15 @@ def pipeline_rules(spec: Optional[PipelineSpec], cfg=None) -> dict:
         "n_stages": spec.n_stages,
         "n_layers": spec.n_layers,
         "n_microbatches": spec.n_microbatches,
+        "n_virtual_stages": spec.n_virtual,
         "n_ticks": spec.n_ticks,
         "bubble_pct": round(spec.bubble_pct, 3),
         "stage_idle_ticks": list(stage_idle_ticks(spec)),
+        # the EXECUTION order (slot j applies chunk j): depth order by
+        # construction whatever the assignment — the record that makes
+        # "interleaved ran the layers in order" a file read
+        "depth_order": [[f"layer_{i}" for i in ch]
+                        for ch in virtual_chunks(spec)],
         "stages": [
             {"stage": s,
              "layers": [f"layer_{i}" for i in layers],

@@ -202,10 +202,11 @@ TELEMETRY_SCHEMA: Dict[str, Optional[frozenset]] = {
     # r22 pipeline parallelism (parallel/pipeline.py; emitted once at
     # startup by cli.run_training on pp>1 meshes) — append-only: one
     # pp_bubble with the schedule's analytic accounting (the executed
-    # program pays exactly this — fill/drain ticks compute on zero
-    # microbatches), one pp_stage per stage with its layer block and
-    # idle/active tick split (what pp_stage_idle_ms scales by measured
-    # tick time)
+    # program pays exactly this — fill/drain ticks compute on recycled
+    # (discarded) microbatch data, never zeros: see the 0*inf
+    # constant-fold note in pipeline.py), one pp_stage per stage with
+    # its layer block and idle/active slot-tick split (what
+    # pp_stage_idle_ms scales by measured tick time)
     "pp_bubble": frozenset({"n_stages", "n_microbatches", "n_ticks",
                             "schedule", "bubble_pct"}),
     "pp_stage": frozenset({"stage", "layers", "idle_ticks",

@@ -49,7 +49,8 @@ from faster_distributed_training_tpu.parallel.mesh import (_ici_device_mesh,
                                                            pp_size)
 from faster_distributed_training_tpu.parallel.pipeline import (
     PipelineSpec, build_pipeline_spec, bubble_fraction, partition_stages,
-    pipeline_rules, resolve_microbatches, schedule_ticks, stage_idle_ticks)
+    pipeline_rules, resolve_microbatches, schedule_ticks, stage_idle_ticks,
+    virtual_chunks)
 from faster_distributed_training_tpu.resilience import faults as faults_mod
 
 _SILENT = lambda *_: None                                 # noqa: E731
@@ -104,9 +105,39 @@ class TestScheduleUnits:
         for L, S in ((8, 2), (7, 3), (9, 4)):
             got = partition_stages(L, S, "interleaved")
             assert sorted(i for st in got for i in st) == list(range(L))
-        # L < 2S: contiguous fallback
-        assert partition_stages(3, 2, "interleaved") == \
-            partition_stages(3, 2, "1f1b")
+        # interleaving requires L % 2S == 0 (equal chunks, slot j on
+        # stage j % S); anything else is the contiguous fallback —
+        # including L < 2S and the ragged L=7,S=3 / L=9,S=4 shapes
+        for L, S in ((3, 2), (6, 2), (7, 3), (9, 4)):
+            assert partition_stages(L, S, "interleaved") == \
+                partition_stages(L, S, "1f1b")
+
+    def test_virtual_chunks_depth_order(self):
+        """The high-severity r22 review fix: the tick loop executes
+        depth-ordered virtual chunks, never a stage's concatenated
+        round-robin layer list — a microbatch must see layer 0..L-1 in
+        order under EVERY schedule."""
+        # interleaved L=8,S=2: stages own (0,1,4,5)/(2,3,6,7) but the
+        # execution order is the four depth chunks, slot j on stage j%S
+        spec = PipelineSpec(
+            n_layers=8, n_stages=2, n_microbatches=4,
+            stage_layers=partition_stages(8, 2, "interleaved"),
+            schedule="interleaved")
+        chunks = virtual_chunks(spec)
+        assert chunks == ((0, 1), (2, 3), (4, 5), (6, 7))
+        assert [i for ch in chunks for i in ch] == list(range(8))
+        # V = 2S virtual slots lengthen fill/drain: T = M + V - 1 and
+        # the HONEST bubble (V-1)/(M+V-1), not the 1f1b (S-1)/(M+S-1)
+        assert spec.n_virtual == 4
+        assert spec.n_ticks == 7
+        assert spec.bubble_pct == pytest.approx(100.0 * 3 / 7)
+        # per-stage idle is per-slot idle x V/S slots
+        assert stage_idle_ticks(spec) == (6, 6)
+        # 1f1b: chunks ARE the stages, everything degenerates to S
+        spec1 = PipelineSpec(n_layers=8, n_stages=2, n_microbatches=4,
+                             stage_layers=partition_stages(8, 2))
+        assert virtual_chunks(spec1) == spec1.stage_layers
+        assert spec1.n_virtual == 2 and spec1.n_ticks == 5
 
     def test_bubble_fraction(self):
         assert bubble_fraction(1, 8) == 0.0
@@ -138,6 +169,12 @@ class TestScheduleUnits:
         assert resolve_microbatches(16, 2, requested=8) == 8
         with pytest.raises(ValueError, match="does not divide"):
             resolve_microbatches(16, 2, requested=3)
+        # negative counts must not sneak past divisibility (8 % -2 == 0
+        # in python) into an obscure downstream reshape failure
+        with pytest.raises(ValueError, match="must be in"):
+            resolve_microbatches(8, 2, requested=-2)
+        with pytest.raises(ValueError, match="must be in"):
+            resolve_microbatches(8, 2, requested=16)
         # auto: largest divisor in [S, 2S] (2S halves the bubble vs S)
         assert resolve_microbatches(16, 2) == 4
         assert resolve_microbatches(16, 4) == 8
@@ -162,6 +199,14 @@ class TestScheduleUnits:
         # the pp=1 delayed-scaling schedule; named ROADMAP follow-on)
         with pytest.raises(ValueError, match="does not compose"):
             build_pipeline_spec(cfg.replace(quant="int8"), mesh)
+        # a live dropout impl warns (different RNG stream than pp=1 —
+        # the parity contract holds with dropout disabled only) ...
+        with pytest.warns(UserWarning, match="dropout"):
+            build_pipeline_spec(cfg.replace(dropout_impl="hash"), mesh)
+        # ... and dropout_impl=none stays silent
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            build_pipeline_spec(cfg.replace(dropout_impl="none"), mesh)
 
     def test_rule_table_shapes(self):
         assert pipeline_rules(None) == {"enabled": False, "n_stages": 1}
@@ -285,6 +330,48 @@ class TestPipelineParity:
                                    float(m_ref["loss"]), rtol=1e-4)
         # post-step params: one optimizer step apart only by the fp32
         # fusion-island class (~1 ULP measured; 1e-4 is the r8 bound)
+        _tree_allclose(s_ref.params, s_pp.params, rtol=1e-4, atol=1e-6)
+
+    def test_interleaved_pp2_step_matches_unstaged(self, requires_devices):
+        """The r22 review's high-severity pin: interleaved assignment
+        must still execute layers in DEPTH order (the tick loop runs
+        virtual_chunks, not a stage's concatenated round-robin list),
+        so pp=2 interleaved sits in the same allclose class vs pp=1 as
+        1f1b does.  L=4, S=2 → four single-layer chunks, stages own
+        (0,2)/(1,3), execution order 0,1,2,3."""
+        requires_devices(4)
+        import optax
+
+        from faster_distributed_training_tpu.cli import build_model
+        from faster_distributed_training_tpu.train.state import (
+            create_train_state)
+        from faster_distributed_training_tpu.train.steps import (
+            make_train_step)
+        cfg = TrainConfig(model="transformer", dataset="synthetic",
+                          task="lm", batch_size=8, seq_len=16, n_layers=4,
+                          d_model=32, d_ff=64, n_heads=4,
+                          dropout_impl="none", optimizer="sgd",
+                          precision="fp32", donate=False, num_classes=4,
+                          pp_schedule="interleaved")
+        mesh = make_mesh(("dp", "pp"), (2, 2), jax.devices()[:4])
+        spec = build_pipeline_spec(cfg, mesh)
+        assert spec.schedule == "interleaved"
+        assert spec.stage_layers == ((0, 2), (1, 3))
+        assert virtual_chunks(spec) == ((0,), (1,), (2,), (3,))
+        assert spec.n_virtual == 4 and spec.n_microbatches == 4
+        model = build_model(cfg, vocab_size=100, mesh=None)
+        sample = jnp.zeros((8, 16), jnp.int32)
+        state = create_train_state(model, optax.sgd(0.1), sample,
+                                   jax.random.PRNGKey(0),
+                                   init_kwargs={"train": True})
+        batch = {"tokens": jax.random.randint(jax.random.PRNGKey(1),
+                                              (8, 16), 0, 100)}
+        with mesh:
+            s_ref, m_ref = jax.jit(make_train_step(cfg))(state, batch)
+            s_pp, m_pp = jax.jit(make_train_step(cfg, pipeline=spec))(
+                state, batch)
+        np.testing.assert_allclose(float(m_pp["loss"]),
+                                   float(m_ref["loss"]), rtol=1e-4)
         _tree_allclose(s_ref.params, s_pp.params, rtol=1e-4, atol=1e-6)
 
     def test_pp1_trace_is_byte_identical(self, parity):
