@@ -1351,6 +1351,61 @@ def zero_opt_state_bytes(zero: bool) -> dict:
             "opt_state_tiers": table.get("opt_state_tiers") or {}}
 
 
+def pp_residency_bytes(staged: bool) -> dict:
+    """Per-chip param + opt-state bytes of a layer-dominated transformer
+    train state on a dp x pp=2 mesh with per-stage residency on
+    (``staged``) vs the r22 replicated-over-pp layout (``--no_pp_
+    residency``) — the zero_opt_state_bytes idiom applied to the r23
+    tentpole.  No stepping: placement is what's being sized.  The model
+    is sized so the per-layer stack dominates the shared embedding
+    tables (the stage-owned fraction is what residency divides by S, so
+    a tiny embeddings-heavy config would understate the ratio real
+    models see).  zero_opt is OFF in both twins so the pair isolates
+    the residency reduction alone; the ZeRO-over-pp composition is
+    pinned functionally by tests/test_pp_residency.py."""
+    import jax
+    import jax.numpy as jnp
+
+    from faster_distributed_training_tpu.cli import build_model
+    from faster_distributed_training_tpu.config import TrainConfig
+    from faster_distributed_training_tpu.optim import build_optimizer
+    from faster_distributed_training_tpu.parallel import make_mesh
+    from faster_distributed_training_tpu.parallel.pipeline import (
+        build_pipeline_spec)
+    from faster_distributed_training_tpu.parallel.placement import (
+        shard_train_state, train_state_shardings)
+    from faster_distributed_training_tpu.telemetry.programs import (
+        state_bytes_table)
+    from faster_distributed_training_tpu.train import create_train_state
+
+    n_dev = jax.device_count()
+    if n_dev < 4:
+        return {"skipped": f"dp x pp=2 sizing needs >=4 chips, host "
+                           f"exposes {n_dev}"}
+    cfg = TrainConfig(model="transformer", dataset="synthetic", task="lm",
+                      batch_size=8, seq_len=64, n_layers=8, d_model=128,
+                      d_ff=512, n_heads=4, dropout_impl="none",
+                      optimizer="adamw", precision="fp32",
+                      mesh_axes=("dp", "pp"), mesh_shape=(2, 2),
+                      zero_opt=False, pp_residency=staged)
+    mesh = make_mesh(cfg.mesh_axes, cfg.mesh_shape, jax.devices()[:4])
+    model = build_model(cfg, vocab_size=256, mesh=None)
+    tx, _ = build_optimizer(cfg, steps_per_epoch=10)
+    sample = jnp.zeros((8, cfg.seq_len), jnp.int32)
+    state = create_train_state(model, tx, sample, jax.random.PRNGKey(0),
+                               init_kwargs={"train": True})
+    pipeline = build_pipeline_spec(cfg, mesh)
+    with mesh:
+        sh = train_state_shardings(state, mesh, cfg, pipeline=pipeline)
+        state = shard_train_state(state, mesh, cfg, shardings=sh)
+        table = state_bytes_table(state)
+    return {"pp_residency": bool(staged),
+            "params_bytes_per_chip": int(table["params_bytes_per_chip"]),
+            "opt_state_bytes_per_chip": int(
+                table["opt_state_bytes_per_chip"]),
+            "pp_residency_table": table.get("pp_residency") or {}}
+
+
 def timed_fused(model: str, k: int, bs: int, seq: int, steps: int,
                 overlap=None, offload: bool = False) -> dict:
     """K-step fused dispatch arm (r8 tentpole): the full train program on
@@ -1837,6 +1892,15 @@ PRODUCED_METRIC_PATTERNS = (
     "weak_scaling_slice2_step_ms",
     "weak_scaling_slice4_step_ms",
     "pipeline_bubble_pct", "pp_stage_idle_ms",
+    # r23 per-stage residency (ISSUE 19 tentpole): dp x pp=2 sizing
+    # twins — per-chip param/opt-state bytes with stage-owned leaves
+    # sharded over pp vs the r22 replicated-over-pp layout, plus the
+    # reduction ratios the headline quotes (~S x at pp=S for the
+    # layer-dominated fraction)
+    "pp_param_bytes_per_chip_pp2_*",
+    "pp_opt_state_bytes_per_chip_pp2_*",
+    "pp_param_residency_reduction_x",
+    "pp_opt_state_residency_reduction_x",
 )
 # *_step_ms arms measured N-interleaved with a published noise band:
 NOISE_BANDED_STEP_MS = (
@@ -2252,6 +2316,17 @@ def main() -> None:
         # ISSUE 16 sizing twins: per-chip opt-state bytes on dp x tp=2
         # with the ZeRO overlay on ("zero") vs forced replicated ("repl")
         print(json.dumps(zero_opt_state_bytes(child.endswith("_zero"))))
+        return
+    if child.startswith("ppbytes_"):
+        # r23 residency sizing twins: per-chip param/opt-state bytes on
+        # dp x pp=2 with per-stage residency on ("staged") vs the r22
+        # replicated-over-pp layout ("repl").  Same virtual-device seam
+        # as the pp_ rungs: the sizing needs a 4-chip mesh.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8")
+        print(json.dumps(pp_residency_bytes(child.endswith("_staged"))))
         return
     if child == "eval_tf":
         print(json.dumps(timed_eval("transformer", 256, 256, tf_steps)))
@@ -2940,6 +3015,34 @@ def main() -> None:
                 elif r and r.get("skipped"):
                     # no silent caps: an unservable rung is recorded
                     record[f"pp_slice{npp}_note"] = r["skipped"]
+            # r23 per-stage residency sizing twins (ISSUE 19 tentpole
+            # headline): per-chip param + opt-state bytes on dp x pp=2
+            # with stage-owned leaves sharded over pp vs the r22
+            # replicated-over-pp layout — the zerobytes_ twin pattern.
+            # Guard class bytes_per_chip (lower is better, 2% band).
+            pb = {m: _run_child(f"ppbytes_{m}")
+                  for m in ("staged", "repl")}
+            st, rp = pb["staged"], pb["repl"]
+            if st and "params_bytes_per_chip" in st:
+                record["pp_param_bytes_per_chip_pp2_staged"] = int(
+                    st["params_bytes_per_chip"])
+                record["pp_opt_state_bytes_per_chip_pp2_staged"] = int(
+                    st["opt_state_bytes_per_chip"])
+            elif st and "skipped" in st:
+                record["pp_residency_bytes_note"] = st["skipped"]
+            if rp and "params_bytes_per_chip" in rp:
+                record["pp_param_bytes_per_chip_pp2_replicated"] = int(
+                    rp["params_bytes_per_chip"])
+                record["pp_opt_state_bytes_per_chip_pp2_replicated"] = \
+                    int(rp["opt_state_bytes_per_chip"])
+                if st and st.get("params_bytes_per_chip"):
+                    record["pp_param_residency_reduction_x"] = round(
+                        rp["params_bytes_per_chip"]
+                        / st["params_bytes_per_chip"], 2)
+                if st and st.get("opt_state_bytes_per_chip"):
+                    record["pp_opt_state_residency_reduction_x"] = round(
+                        rp["opt_state_bytes_per_chip"]
+                        / st["opt_state_bytes_per_chip"], 2)
         # Eval throughput under the guard (VERDICT r5 #7): the real
         # pad-and-mask eval step at each workload's headline shape.
         ev = _run_child("eval_resnet")
@@ -3115,6 +3218,12 @@ def _essentials(record: dict) -> dict:
             "weak_scaling_slice1_step_ms", "weak_scaling_slice2_step_ms",
             "weak_scaling_slice4_step_ms",
             "pipeline_bubble_pct", "pp_stage_idle_ms",
+            "pp_param_bytes_per_chip_pp2_staged",
+            "pp_param_bytes_per_chip_pp2_replicated",
+            "pp_opt_state_bytes_per_chip_pp2_staged",
+            "pp_opt_state_bytes_per_chip_pp2_replicated",
+            "pp_param_residency_reduction_x",
+            "pp_opt_state_residency_reduction_x",
             "bench_unix_time", "regression_baseline_file")
     ess = {"essentials": True, "full_record": BENCH_LATEST}
     for k in keys:

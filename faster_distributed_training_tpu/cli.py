@@ -858,7 +858,9 @@ def run_training(cfg: TrainConfig,
     # None on every pp=1 mesh — those programs stay byte-identical.
     from faster_distributed_training_tpu.parallel.pipeline import (
         build_pipeline_spec, pipeline_rules, stage_idle_ticks)
-    pipeline = build_pipeline_spec(cfg, mesh)
+    pipeline = build_pipeline_spec(
+        cfg, mesh,
+        attention_impl=getattr(model, "attention_impl", None))
     if pipeline is not None:
         log(f"[pipeline] pp={pipeline.n_stages} stages x "
             f"{pipeline.n_microbatches} microbatches "
@@ -906,7 +908,8 @@ def run_training(cfg: TrainConfig,
     from faster_distributed_training_tpu.parallel.mesh import (pp_size,
                                                                sp_size,
                                                                tp_size)
-    shardings = (train_state_shardings(state, mesh, cfg)
+    shardings = (train_state_shardings(state, mesh, cfg,
+                                       pipeline=pipeline)
                  if cfg.host_offload or cfg.offload_opt_state
                  or cfg.overlap_grad_reduce or tp_size(mesh) > 1
                  or sp_size(mesh) > 1 or pp_size(mesh) > 1 else None)

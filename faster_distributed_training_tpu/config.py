@@ -65,6 +65,14 @@ class TrainConfig:
                                       # chunks in DEPTH order, so both
                                       # schedules compute the pp=1
                                       # function (pipeline.py)
+    pp_residency: bool = True         # shard stage-owned params (and,
+                                      # via the ZeRO overlay, their
+                                      # opt-state mirrors) over pp so
+                                      # per-chip HBM scales ~1/S with
+                                      # pipeline depth (sharding.py
+                                      # pp_residency_specs);
+                                      # --no_pp_residency restores the
+                                      # r22 replicated-over-pp layout
 
     # -- optimization (reference flag surface) ----------------------------
     lr: float = 0.1
@@ -788,6 +796,12 @@ def build_parser(prog: str = "fdt",
                         "0, contiguous fallback otherwise) — executed "
                         "in depth order either way, at the price of a "
                         "longer fill/drain (bubble (2S-1)/(M+2S-1))")
+    p.add_argument("--no_pp_residency", action="store_true",
+                   help="keep params/opt-state replicated over pp (the "
+                        "r22 layout) instead of the default per-stage "
+                        "residency (sharding.py pp_residency_specs) — "
+                        "the interchange/twin baseline, and the right "
+                        "call when pp fits one slice anyway")
     p.add_argument("--stream_dir", default=d.stream_dir, type=str,
                    help="sharded stream dataset root (train/ + test/ "
                         "subdirs; scripts/shard_dataset.py writes one) — "
@@ -953,6 +967,7 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         lm_causal=args.lm_causal,
         pp_microbatches=args.pp_microbatches,
         pp_schedule=args.pp_schedule,
+        pp_residency=not args.no_pp_residency,
         fsdp=args.fsdp, zero1=args.zero1, host_offload=args.host_offload,
         zero_opt=not args.no_zero_opt,
         offload_opt_state=args.offload_opt_state,

@@ -196,6 +196,15 @@ class MultiheadAttention(nn.Module):
                                       # forward GEMMs for qkv + out with
                                       # delayed per-tensor scaling
                                       # (ops/quant.py); None = bf16/fp32
+    pp_ctx: Optional[Any] = None      # parallel.pipeline.PipelineTickCtx
+                                      # on a pp>1 mesh (r23): per-site
+                                      # stable dropout seeds + global
+                                      # (b,h) stream offsets so the
+                                      # microbatched attention dropout
+                                      # equals pp=1's mask slice, and
+                                      # the QuantDense amax cadence.
+                                      # None (pp=1) leaves every trace
+                                      # byte-identical
 
     @nn.compact
     def __call__(self, x: jax.Array, mask: Optional[jax.Array],
@@ -214,6 +223,7 @@ class MultiheadAttention(nn.Module):
                                                "frozen_scales", False),
                          grad_fmt=getattr(self.quant, "grad_fmt", None),
                          mesh=self.mesh,
+                         amax_cadence=self.pp_ctx,
                          dtype=self.dtype, param_dtype=self.param_dtype)
                     if self.quant is not None else None)
         # projection-boundary annotations for a (data, model) mesh
@@ -281,9 +291,20 @@ class MultiheadAttention(nn.Module):
                          and self.dropout_impl != "none") else 0.0)
         use_hash = (self.attention_impl != "dense"
                     or self.dropout_impl == "hash")
-        drop_seed = (jax.random.bits(self.make_rng("dropout"),
-                                     dtype=jnp.uint32)
-                     if drop_rate > 0 and use_hash else None)
+        if drop_rate > 0 and use_hash:
+            draw = lambda: jax.random.bits(     # noqa: E731
+                self.make_rng("dropout"), dtype=jnp.uint32)
+            if self.pp_ctx is not None:
+                # r23 pipeline parity: ONE seed per site per step (the
+                # first draw — make_rng fold count 0, pp=1's key), every
+                # tick; the microbatch's position enters via the global
+                # (b, h) stream offset below instead
+                site = "/".join(str(p) for p in self.scope.path)
+                drop_seed = self.pp_ctx.site_seed(site + ":attn", draw)
+            else:
+                drop_seed = draw()
+        else:
+            drop_seed = None
         if self.attention_impl == "flash":
             from faster_distributed_training_tpu.ops.flash_attention import (
                 flash_attention)
@@ -327,9 +348,18 @@ class MultiheadAttention(nn.Module):
             # dense with the hash engine: same softmax-then-hash-keep
             # semantics as every kernel path, no threefry mask tensor
             from faster_distributed_training_tpu.ops.attention import (
-                dense_attention_reference)
+                bh_index, dense_attention_reference)
+            bh = None
+            if self.pp_ctx is not None:
+                # address the GLOBAL (b, h) stream: this microbatch's
+                # batch rows start at row0, so its (b, h) indices are
+                # pp=1's shifted by row0*h — the mask equals pp=1's
+                # slice for these rows (r23)
+                bh = bh_index(B, self.h) + jnp.int32(
+                    self.pp_ctx.row0 * self.h)
             ctx = dense_attention_reference(q, k, v, mask, drop_rate,
-                                            dropout_seed=drop_seed)
+                                            dropout_seed=drop_seed,
+                                            dropout_bh=bh)
         else:
             # dropout inactive (eval / rate 0): ONE dense path for every
             # engine, so a training-only flag cannot shift inference
@@ -382,6 +412,10 @@ class PositionalWiseFFN(nn.Module):
     dropout_impl: str = "hash"
     mesh: Optional[Any] = None
     quant: Optional[Any] = None   # QuantPolicy: int8/fp8 FFN GEMMs
+    pp_ctx: Optional[Any] = None  # PipelineTickCtx on pp>1 (r23): stable
+                                  # per-site dropout seed + microbatch
+                                  # stream offset, QuantDense amax
+                                  # cadence; None = unchanged trace
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
@@ -399,7 +433,7 @@ class PositionalWiseFFN(nn.Module):
                        frozen_scales=getattr(self.quant,
                                              "frozen_scales", False),
                        grad_fmt=getattr(self.quant, "grad_fmt", None),
-                       mesh=self.mesh, **kw)
+                       mesh=self.mesh, amax_cadence=self.pp_ctx, **kw)
             # Megatron roles for the r19 shard_map quant layer: Dense_0
             # column-parallel (d_ff out), Dense_1 row-parallel (d_ff in,
             # one psum) — the _TP_RULES layout
@@ -414,8 +448,8 @@ class PositionalWiseFFN(nn.Module):
             h = shard_activation(h, self.mesh,
                                  (mesh_data_axes(self.mesh), None, "tp"))
         h = nn.gelu(h, approximate=False)
-        h = FastDropout(self.dropout, self.dropout_impl)(
-            h, deterministic=not train)
+        h = FastDropout(self.dropout, self.dropout_impl,
+                        pp_ctx=self.pp_ctx)(h, deterministic=not train)
         return dense_1(h)
 
 
@@ -536,6 +570,13 @@ class EncoderLayer(nn.Module):
                                     # kernel runs its two GEMMs on the
                                     # quantized operands in-kernel (r19
                                     # — the bf16-only caveat is gone)
+    pp_ctx: Optional[Any] = None    # parallel.pipeline.PipelineTickCtx
+                                    # on a pp>1 mesh (r23), threaded to
+                                    # every dropout site (stable seeds +
+                                    # microbatch stream offsets) and
+                                    # every QuantDense (one amax roll
+                                    # per step).  None on pp=1: all
+                                    # traces byte-identical to r22
 
     @nn.compact
     def __call__(self, h: jax.Array, mask: Optional[jax.Array],
@@ -565,11 +606,12 @@ class EncoderLayer(nn.Module):
                                self.sp_axis, self.fused_qkv,
                                dropout_impl=self.dropout_impl,
                                flash_save_stats=self.flash_save_stats,
-                               quant=self.quant,
+                               quant=self.quant, pp_ctx=self.pp_ctx,
                                name="attn")(a, mask, train)
         a = FastDropout(self.dropout_connection_attention,
-                        self.dropout_impl)(seq_shard(a),
-                                           deterministic=not train)
+                        self.dropout_impl,
+                        pp_ctx=self.pp_ctx)(seq_shard(a),
+                                            deterministic=not train)
         h = seq_shard(h + a)
         # ADVICE r5 (medium): the kernel's in-VMEM dropout IS the hash
         # engine — it must follow dropout_impl like every other site.
@@ -610,8 +652,18 @@ class EncoderLayer(nn.Module):
                 self.d_model, self.d_ff, self.dtype, self.param_dtype,
                 quant=self.quant, name="ffn")(h[..., :1, :])
             if ffn_dropout_active:
-                seeds = jax.random.bits(self.make_rng("dropout"), (2,),
-                                        dtype=jnp.uint32)
+                draw = lambda: jax.random.bits(     # noqa: E731
+                    self.make_rng("dropout"), (2,), dtype=jnp.uint32)
+                if self.pp_ctx is not None:
+                    # stable per-step seeds (first draw) — NOTE this is
+                    # determinism only, not pp=1 parity: the fused
+                    # kernel's masks address per-invocation row indices,
+                    # so build_pipeline_spec keeps the warning for
+                    # pallas FFN + dropout under pp
+                    site = "/".join(str(p) for p in self.scope.path)
+                    seeds = self.pp_ctx.site_seed(site + ":ffn", draw)
+                else:
+                    seeds = draw()
                 hid_seed, out_seed = seeds[0], seeds[1]
                 r_h, r_c = self.dropout_ffn, self.dropout_connection_ffn
             else:
@@ -634,10 +686,19 @@ class EncoderLayer(nn.Module):
                     if fmt is not None else None)
             if fmt is not None:
                 mg = self.quant.margin
-                scales = (scale_from_history(hx1.value, fmt, mg),
-                          scale_from_history(hw1.value, fmt, mg),
-                          scale_from_history(hx2.value, fmt, mg),
-                          scale_from_history(hw2.value, fmt, mg))
+                if self.pp_ctx is not None:
+                    # pipeline amax cadence (r23): every tick quantizes
+                    # at the PRE-step scales (what pp=1 uses all step)
+                    qsite = "/".join(str(p) for p in self.scope.path)
+                    hists = (
+                        self.pp_ctx.amax_pre(qsite + ":hx1", hx1.value),
+                        self.pp_ctx.amax_pre(qsite + ":hw1", hw1.value),
+                        self.pp_ctx.amax_pre(qsite + ":hx2", hx2.value),
+                        self.pp_ctx.amax_pre(qsite + ":hw2", hw2.value))
+                else:
+                    hists = (hx1.value, hw1.value, hx2.value, hw2.value)
+                scales = tuple(scale_from_history(hh, fmt, mg)
+                               for hh in hists)
             else:
                 scales = None
             if tp_size(self.mesh) > 1:
@@ -669,12 +730,26 @@ class EncoderLayer(nn.Module):
             # dropout activation), w-side from the cast weights
             if (not getattr(self.quant, "frozen_scales", False)
                     and self.is_mutable_collection("batch_stats")):
-                hx1.value = update_amax_history(hx1.value, amax2[0])
-                hx2.value = update_amax_history(hx2.value, amax2[1])
-                hw1.value = update_amax_history(hw1.value,
-                                                tensor_amax(w1c))
-                hw2.value = update_amax_history(hw2.value,
-                                                tensor_amax(w2c))
+                if self.pp_ctx is not None:
+                    # one roll per optimizer step: first real push
+                    # rolls, later ticks max-reduce into slot 0,
+                    # bubble ticks skipped (PipelineTickCtx.amax_push)
+                    cad, qs = self.pp_ctx, qsite
+                    hx1.value = cad.amax_push(qs + ":hx1", hx1.value,
+                                              amax2[0])
+                    hx2.value = cad.amax_push(qs + ":hx2", hx2.value,
+                                              amax2[1])
+                    hw1.value = cad.amax_push(qs + ":hw1", hw1.value,
+                                              tensor_amax(w1c))
+                    hw2.value = cad.amax_push(qs + ":hw2", hw2.value,
+                                              tensor_amax(w2c))
+                else:
+                    hx1.value = update_amax_history(hx1.value, amax2[0])
+                    hx2.value = update_amax_history(hx2.value, amax2[1])
+                    hw1.value = update_amax_history(hw1.value,
+                                                    tensor_amax(w1c))
+                    hw2.value = update_amax_history(hw2.value,
+                                                    tensor_amax(w2c))
             return out
         f = ln("ln_ffn")(h)
         ffn_cls = (nn.remat(PositionalWiseFFN, static_argnums=(2,))
@@ -682,10 +757,11 @@ class EncoderLayer(nn.Module):
         f = ffn_cls(self.d_model, self.d_ff, self.dropout_ffn,
                     self.dtype, self.param_dtype,
                     self.dropout_impl, self.mesh, self.quant,
-                    name="ffn")(f, train)
+                    self.pp_ctx, name="ffn")(f, train)
         f = FastDropout(self.dropout_connection_ffn,
-                        self.dropout_impl)(seq_shard(f),
-                                           deterministic=not train)
+                        self.dropout_impl,
+                        pp_ctx=self.pp_ctx)(seq_shard(f),
+                                            deterministic=not train)
         return seq_shard(h + f)
 
 
@@ -836,13 +912,14 @@ class Transformer(nn.Module):
             # the layer applications changes — the batch runs as M
             # microbatches through V rotating virtual-stage slots, and
             # jax.grad through the rotation yields the reversed (1F1B)
-            # backward pipeline.  With dropout LIVE the per-tick layer
-            # invocations draw a different make_rng stream than the
-            # unstaged forward (bubble slots included), so the pp ≡
-            # pp=1 parity class requires dropout disabled —
-            # build_pipeline_spec warns (pipeline.py docstring).
+            # backward pipeline.  PipelineTickCtx (r23) restores pp ≡
+            # pp=1 with dropout LIVE on the hash engine (stable
+            # per-site seeds + global microbatch stream offsets) and
+            # with --quant (one amax roll per optimizer step) —
+            # build_pipeline_spec still warns for the non-parity
+            # engine combos (pipeline.py docstring).
             from faster_distributed_training_tpu.parallel.pipeline import (
-                constrain_stage_buffer, virtual_chunks)
+                PipelineTickCtx, constrain_stage_buffer, virtual_chunks)
             spec = pp_spec
             # the tick loop runs the depth-ordered VIRTUAL chunks, not
             # a stage's concatenated layer list: slot j applies chunk j
@@ -855,6 +932,16 @@ class Transformer(nn.Module):
             if B % M:
                 raise ValueError(f"batch {B} not divisible by "
                                  f"{M} pipeline microbatches")
+            # ONE mutable trace-time context shared by every layer: the
+            # tick loop below sets (microbatch, bubble) before each slot
+            # invocation and the dropout/quant sites read them at trace
+            # time (the loop is python-unrolled, so each invocation
+            # bakes its own values into the jaxpr).  Under --remat each
+            # tick's layer call is its OWN checkpoint trace, so the
+            # ctx's cross-tick stashes (seeds, amax histories) would
+            # leak tracers between traces — no ctx there (r22 per-tick
+            # behavior; build_pipeline_spec warns/refuses accordingly)
+            ctx = None if self.remat else PipelineTickCtx(M, B // M)
             layers = [layer_cls(self.h, self.d_model, self.d_ff,
                                 self.dropout_connection_attention,
                                 self.dropout_connection_ffn,
@@ -864,6 +951,7 @@ class Transformer(nn.Module):
                                 self.sp_axis, self.dropout_impl,
                                 remat_ffn, self.fused_qkv, self.ffn_impl,
                                 flash_save_stats, self.quant,
+                                pp_ctx=ctx,
                                 name=f"layer_{i}")
                       for i in range(self.n_layers)]
             hs = h.reshape((M, B // M) + h.shape[1:])
@@ -897,6 +985,13 @@ class Transformer(nn.Module):
                         # (clamped for bubble slots — their output is
                         # discarded, any finite mask will do)
                         m_ = bmask[min(max(t - j, 0), M - 1)]
+                    # which microbatch this slot is processing (same
+                    # clamp as the mask) and whether it's a fill/drain
+                    # bubble — read at trace time by the r23 dropout
+                    # offsets and the quant amax cadence
+                    if ctx is not None:
+                        ctx.microbatch = min(max(t - j, 0), M - 1)
+                        ctx.bubble = not (0 <= t - j < M)
                     for i in chunks[j]:
                         z = layers[i](z, m_, train)
                     slots.append(z)

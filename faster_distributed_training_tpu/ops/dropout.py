@@ -150,14 +150,26 @@ def keep_factor_tile(seed: jax.Array, row0: jax.Array, rows: int, cols: int,
     return keep_factor_rows(seed, r, cols, rate)
 
 
-def _keep_factor(seed: jax.Array, shape, rate: float) -> jax.Array:
+def _keep_factor(seed: jax.Array, shape, rate: float,
+                 offset: int = 0) -> jax.Array:
     """0 or 1/realized_keep per element, shaped like the input — ALWAYS
     float32: the scale multiplies in fp32 and the product is cast back
     to the activation dtype once (ADVICE r4 #3; casting the factor
     itself to bf16 first would bias the scale by up to ~0.4%).  Built on
-    keep_factor_tile so every consumer shares one stream definition."""
+    keep_factor_tile so every consumer shares one stream definition.
+
+    ``offset`` (static python int) shifts the element indices: element i
+    of this tensor draws the stream word of global element offset+i.  A
+    pipeline microbatch covering rows [row0, row0+rows) of the full
+    batch passes offset = row0 * prod(shape[1:]) and reproduces exactly
+    the slice of the full-tensor mask pp=1 would apply to those rows
+    (parallel/pipeline.py r23).  offset=0 traces the original
+    keep_factor_tile path so pp=1 programs stay byte-identical."""
     n = int(np.prod(shape)) if shape else 1
-    guard_index_ceiling(n)
+    guard_index_ceiling(int(offset) + n)
+    if offset:
+        return keep_factor_rows(seed, jnp.zeros((1,), jnp.uint32), n,
+                                rate, col0=int(offset)).reshape(shape)
     return keep_factor_tile(seed, jnp.uint32(0), 1, n, rate).reshape(shape)
 
 
@@ -166,19 +178,20 @@ def _scale(x: jax.Array, factor: jax.Array) -> jax.Array:
     return (x.astype(jnp.float32) * factor).astype(x.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def _hash_dropout(x: jax.Array, seed: jax.Array, rate: float) -> jax.Array:
-    return _scale(x, _keep_factor(seed, x.shape, rate))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _hash_dropout(x: jax.Array, seed: jax.Array, rate: float,
+                  offset: int = 0) -> jax.Array:
+    return _scale(x, _keep_factor(seed, x.shape, rate, offset))
 
 
-def _hd_fwd(x, seed, rate):
+def _hd_fwd(x, seed, rate, offset):
     # residual: the scalar seed ONLY — no mask, no input
-    return _hash_dropout(x, seed, rate), seed
+    return _hash_dropout(x, seed, rate, offset), seed
 
 
-def _hd_bwd(rate, seed, g):
+def _hd_bwd(rate, offset, seed, g):
     # the cotangent has the primal's shape/dtype; the mask is REGENERATED
-    dx = _scale(g, _keep_factor(seed, g.shape, rate))
+    dx = _scale(g, _keep_factor(seed, g.shape, rate, offset))
     return dx, np.zeros((), jax.dtypes.float0)
 
 
@@ -186,9 +199,13 @@ _hash_dropout.defvjp(_hd_fwd, _hd_bwd)
 
 
 def hash_dropout(x: jax.Array, seed: jax.Array, rate: float,
-                 deterministic: bool = False) -> jax.Array:
+                 deterministic: bool = False,
+                 offset: int = 0) -> jax.Array:
     """Apply stateless hash dropout.  seed: u32 scalar (one fresh value
-    per site per step); rate: static python float in [0, 1]."""
+    per site per step); rate: static python float in [0, 1]; offset:
+    static global-element index of this tensor's element 0 (0 = the
+    whole tensor — the default; pipeline microbatches pass their row
+    offset so the mask equals pp=1's slice, see _keep_factor)."""
     if deterministic or rate <= 0.0:
         return x
     t = _thresh_u16(rate)
@@ -196,7 +213,7 @@ def hash_dropout(x: jax.Array, seed: jax.Array, rate: float,
         return x
     if t <= 0:        # rate above 1 - half a grid step -> drop everything
         return jnp.zeros_like(x)
-    return _hash_dropout(x, jnp.asarray(seed), rate)
+    return _hash_dropout(x, jnp.asarray(seed), rate, int(offset))
 
 
 def realized_rate(rate: float) -> float:
@@ -218,10 +235,18 @@ try:  # flax is an optional dependency of this module's function core
                  dropout rng key's impl — the train step picks per
                  ``cfg.dropout_rng_impl``);
           none — dropout disabled (roofline floor probes).
+
+        ``pp_ctx`` (a parallel.pipeline.PipelineTickCtx, r23): the site
+        draws its seed ONCE (first tick — make_rng fold count 0, i.e.
+        pp=1's key for this module path) and offsets the hash stream by
+        the current microbatch's global row so every microbatch applies
+        exactly pp=1's mask slice.  hash impl only; None (every pp=1
+        program) leaves the trace untouched.
         """
         rate: float
         impl: str = "hash"
         rng_collection: str = "dropout"
+        pp_ctx: object = None
 
         @nn.compact
         def __call__(self, x: jax.Array,
@@ -231,8 +256,13 @@ try:  # flax is an optional dependency of this module's function core
             if self.impl == "xla":
                 return nn.Dropout(self.rate, deterministic=False,
                                   rng_collection=self.rng_collection)(x)
-            seed = jax.random.bits(self.make_rng(self.rng_collection),
-                                   dtype=jnp.uint32)
-            return hash_dropout(x, seed, self.rate)
+            draw = lambda: jax.random.bits(     # noqa: E731
+                self.make_rng(self.rng_collection), dtype=jnp.uint32)
+            if self.pp_ctx is not None:
+                site = "/".join(str(p) for p in self.scope.path)
+                seed = self.pp_ctx.site_seed(site, draw)
+                offset = self.pp_ctx.row0 * int(np.prod(x.shape[1:]))
+                return hash_dropout(x, seed, self.rate, offset=offset)
+            return hash_dropout(x, draw(), self.rate)
 except ImportError:  # pragma: no cover
     pass

@@ -469,6 +469,20 @@ try:
         bias_init: object = nn.initializers.zeros
         dtype: object = jnp.float32
         param_dtype: object = jnp.float32
+        amax_cadence: object = None         # parallel.pipeline
+                                            # .PipelineTickCtx (r23): on
+                                            # a pp>1 mesh this site is
+                                            # invoked once per pipeline
+                                            # tick — the cadence keeps
+                                            # delayed scaling at ONE
+                                            # roll per optimizer step
+                                            # (scales from the pre-step
+                                            # history, pushes max-
+                                            # reduced over the real
+                                            # microbatches) so the
+                                            # scale state matches pp=1
+                                            # bitwise.  None (pp=1) =
+                                            # the plain roll below
 
         @nn.compact
         def __call__(self, x: jax.Array) -> jax.Array:
@@ -502,16 +516,44 @@ try:
                 # trace so profiles show the refresh cost under one
                 # vocabulary with the telemetry spans
                 with jax.named_scope("fdt/quant_scale_refresh"):
-                    sx = scale_from_history(hist_x.value, self.fmt,
-                                            self.margin)
-                    sw = scale_from_history(hist_w.value, self.fmt,
-                                            self.margin)
-                    if (not self.frozen_scales
-                            and self.is_mutable_collection("batch_stats")):
-                        hist_x.value = update_amax_history(
-                            hist_x.value, tensor_amax(x2d))
-                        hist_w.value = update_amax_history(
-                            hist_w.value, tensor_amax(w2d))
+                    cad = self.amax_cadence
+                    if cad is not None:
+                        # pipeline tick cadence: EVERY tick quantizes at
+                        # the scales the pre-step history implies (the
+                        # same scales pp=1 uses all step), and the
+                        # history rolls once — the first real push
+                        # rolls, later pushes max-reduce into slot 0,
+                        # bubble ticks are skipped entirely (their
+                        # recycled data could exceed the true batch
+                        # amax).  End-of-step hist == pp=1's bitwise.
+                        site = "/".join(str(p) for p in self.scope.path)
+                        hx0 = cad.amax_pre(site + ":x", hist_x.value)
+                        hw0 = cad.amax_pre(site + ":w", hist_w.value)
+                        sx = scale_from_history(hx0, self.fmt,
+                                                self.margin)
+                        sw = scale_from_history(hw0, self.fmt,
+                                                self.margin)
+                        if (not self.frozen_scales
+                                and self.is_mutable_collection(
+                                    "batch_stats")):
+                            hist_x.value = cad.amax_push(
+                                site + ":x", hist_x.value,
+                                tensor_amax(x2d))
+                            hist_w.value = cad.amax_push(
+                                site + ":w", hist_w.value,
+                                tensor_amax(w2d))
+                    else:
+                        sx = scale_from_history(hist_x.value, self.fmt,
+                                                self.margin)
+                        sw = scale_from_history(hist_w.value, self.fmt,
+                                                self.margin)
+                        if (not self.frozen_scales
+                                and self.is_mutable_collection(
+                                    "batch_stats")):
+                            hist_x.value = update_amax_history(
+                                hist_x.value, tensor_amax(x2d))
+                            hist_w.value = update_amax_history(
+                                hist_w.value, tensor_amax(w2d))
                 from faster_distributed_training_tpu.parallel import (
                     kernel_shard)
                 if kernel_shard.quant_tp_routed(self.mesh, self.tp_dim,

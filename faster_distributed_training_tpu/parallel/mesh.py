@@ -16,7 +16,11 @@ Axis naming convention used framework-wide:
            stage-boundary activation rotation is the only per-step
            collective, so pp tolerates the slowest links and is the
            PREFERRED axis to span DCN on multi-slice pods —
-           parallel/pipeline.py)
+           parallel/pipeline.py.  Since r23 pp is also a RESIDENCY
+           axis: stage-owned params and optimizer state are physically
+           sharded over pp (parallel/sharding.py pp-residency rules),
+           so per-chip HBM for those tiers scales ~1/S with pipeline
+           depth and pp composes multiplicatively with tp/ZeRO.)
 
 AXIS_ALIASES is the ONE canonical alias table (r11 satellite): every
 surface that names a mesh axis — ``--mesh`` parsing, ``resolve_attention``

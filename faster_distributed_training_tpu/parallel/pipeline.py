@@ -34,13 +34,17 @@ argument so ``pp=1`` traces stay byte-identical to r21):
     the documented cross-program-family allclose class (batch-dim
     tiling + microbatch reduction order), while within a pp program
     family everything stays bitwise (the r8 scan-rounding precedent).
-    The parity contract holds with DROPOUT DISABLED only: under the
-    staged encoder each layer is invoked once per tick (bubble slots
-    included), so Flax's make_rng fold count differs from the unstaged
-    forward and bubble slots consume dropout draws — still valid
-    dropout (an independent mask stream), but a different stream than
-    pp=1, so pp=2 vs pp=1 is not comparable beyond distribution.
-    build_pipeline_spec warns when pp>1 meets a live dropout impl.
+    Since r23 the parity contract also holds with dropout LIVE on the
+    hash engine (dense attention, flax FFN): the tick loop threads a
+    PipelineTickCtx through the layers — per-site seeds stashed at the
+    first (fold-count-0) make_rng draw so later ticks and bubble slots
+    never consume draws, and every dropout site offsets its hash
+    stream by the microbatch's GLOBAL row0, so each microbatch sees
+    exactly its slice of pp=1's mask.  The same ctx carries the
+    delayed-scaling amax cadence that lets --quant compose (one
+    history roll per optimizer step; see PipelineTickCtx).
+    build_pipeline_spec warns for the remaining non-parity dropout
+    combos (xla engine, pallas FFN, flash/ring/ulysses attention).
 
 The schedule is 1F1B in the combined fwd+bwd sense: jax.grad
 differentiates through the rotation, so the backward pipeline replays
@@ -75,11 +79,133 @@ and the extra work is exactly the analytic bubble fraction
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Optional, Sequence, Tuple
 
 from faster_distributed_training_tpu.parallel.mesh import pp_size
 
 SCHEDULES = ("1f1b", "interleaved")
+
+_LAYER_RE = re.compile(r"(?:^|/)layer_(\d+)(?:/|$)")
+
+# markers for the post-encoder shared leaves (param_stage_home): params
+# applied AFTER the staged region on the reassembled batch, logically
+# homed on the last stage.  Anything matching none of the tables below
+# classifies "unknown" and the sharding lint fails until it is covered
+# (sharding.REPLICATED_PP_PARAMS "pp_unmatched").
+_HEAD_MARKERS = ("ln_final", "pooler", "cls_", "lm_head")
+
+
+def param_stage_home(spec: "PipelineSpec", flat_name: str
+                     ) -> Tuple[str, Optional[int]]:
+    """(role, stage) for a '/'-joined param/batch_stats path — THE
+    stage-home rule every residency surface shares (the sharding
+    overlay, the rule table, the lint):
+
+      ('stage_owned',  s)    — leaf under layer_{i}, i in stage s's
+                               assignment;
+      ('shared_embed', 0)    — embedding tables (consumed by stage 0's
+                               input assembly; the tied LM head also
+                               reads the token table on the LAST stage,
+                               which is why they replicate over pp);
+      ('shared_head',  S-1)  — ln_final/pooler/classifier/lm_head,
+                               applied after the staged region;
+      ('unknown',      None) — nothing matched; the lint fails until a
+                               rule covers the new leaf class.
+    """
+    low = flat_name.lower()
+    m = _LAYER_RE.search(low)
+    if m:
+        li = int(m.group(1))
+        for s, layers in enumerate(spec.stage_layers):
+            if li in layers:
+                return "stage_owned", s
+        return "unknown", None
+    if "embedding" in low:
+        return "shared_embed", 0
+    if any(mk in low for mk in _HEAD_MARKERS):
+        return "shared_head", spec.n_stages - 1
+    return "unknown", None
+
+
+class PipelineTickCtx:
+    """Trace-time context the staged tick loop threads through the
+    layer modules (models/transformer.py staged branch) so the
+    per-TICK invocation pattern reproduces pp=1's per-STEP semantics
+    for the two stateful per-site mechanisms:
+
+      * dropout seeds (``site_seed``): pp=1 draws each site's seed
+        once per step; the staged encoder invokes every layer once per
+        tick, so repeated make_rng calls would fold a different count
+        per tick and bubble slots would consume draws.  The ctx stashes
+        the FIRST invocation's draw (Flax fold count 0 — the same key
+        pp=1's single call derives) and replays it every later tick;
+        combined with the global row offset (``row0`` — the microbatch
+        id times the microbatch size, NOT the tick or slot index) each
+        microbatch addresses exactly its slice of pp=1's hash-dropout
+        index stream.
+      * delayed-scaling amax cadence (``amax_pre``/``amax_push``):
+        one history roll per optimizer step instead of one per tick —
+        every tick quantizes at the PRE-step scale (pp=1's scale), the
+        first REAL (non-bubble) invocation rolls the history, later
+        real invocations max their microbatch amax into slot 0, and
+        bubble invocations never touch it (their recycled fill/drain
+        data could exceed the true batch max).  max-of-microbatch-
+        maxes == the full-batch amax bitwise, so the post-step scale
+        state matches pp=1 exactly (tests/test_pp_residency.py pins
+        it).
+
+    The object is created fresh inside the staged branch at every
+    trace (including the once-per-dispatch trace of the K-step scan
+    body), so nothing leaks across traces; the tick loop sets
+    ``microbatch``/``bubble`` before each slot invocation (the loop is
+    unrolled python, so module calls observe the current values at
+    trace time)."""
+
+    def __init__(self, n_microbatches: int, microbatch_rows: int):
+        self.n_microbatches = int(n_microbatches)
+        self.microbatch_rows = int(microbatch_rows)
+        self.microbatch = 0      # clamped microbatch id of the current slot
+        self.bubble = False      # fill/drain invocation (output discarded)
+        self._seeds: dict = {}
+        self._amax_rolled: set = set()
+        self._amax_pre: dict = {}
+
+    @property
+    def row0(self) -> int:
+        """Global batch-row offset of the current microbatch — the
+        static offset dropout sites add to address pp=1's index
+        stream."""
+        return self.microbatch * self.microbatch_rows
+
+    def site_seed(self, site: str, draw):
+        """The site's per-step dropout seed: ``draw()`` (a make_rng
+        bits draw) on the first invocation, the stashed tracer after —
+        later ticks and bubble slots never consume rng draws."""
+        if site not in self._seeds:
+            self._seeds[site] = draw()
+        return self._seeds[site]
+
+    def amax_pre(self, site: str, hist):
+        """The site's PRE-step amax history (stashed at first touch):
+        every tick's scale comes from it, exactly like pp=1's single
+        scale_from_history read."""
+        if site not in self._amax_pre:
+            self._amax_pre[site] = hist
+        return self._amax_pre[site]
+
+    def amax_push(self, site: str, hist, amax):
+        """One-roll-per-step history update; returns the new history
+        value.  Bubble invocations return ``hist`` untouched."""
+        if self.bubble:
+            return hist
+        import jax.numpy as jnp
+        from faster_distributed_training_tpu.ops.quant import (
+            update_amax_history)
+        if site in self._amax_rolled:
+            return hist.at[0].set(jnp.maximum(hist[0], amax))
+        self._amax_rolled.add(site)
+        return update_amax_history(self.amax_pre(site, hist), amax)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -248,11 +374,18 @@ def resolve_microbatches(batch_size: int, n_stages: int,
     return 1
 
 
-def build_pipeline_spec(cfg, mesh) -> Optional[PipelineSpec]:
+def build_pipeline_spec(cfg, mesh,
+                        attention_impl: Optional[str] = None
+                        ) -> Optional[PipelineSpec]:
     """The spec for this (cfg, mesh), or None when the mesh has no pp
     axis of size > 1 — the None path is what keeps pp=1 programs
     byte-identical (callers select today's unstaged code path on None,
-    they never trace a degenerate 1-stage pipeline)."""
+    they never trace a degenerate 1-stage pipeline).
+
+    ``attention_impl``: the RESOLVED attention implementation when the
+    caller knows it (cli passes build_model's choice); None falls back
+    to cfg.attention, where "" (auto) is treated conservatively for
+    the dropout-parity predicate below."""
     stages = pp_size(mesh)
     if stages <= 1:
         return None
@@ -261,31 +394,59 @@ def build_pipeline_spec(cfg, mesh) -> Optional[PipelineSpec]:
             f"--mesh with pp={stages}: pipeline parallelism stages the "
             f"transformer encoder; model {cfg.model!r} has no staged "
             f"form")
-    if (getattr(cfg, "quant", "none") or "none") != "none":
-        # each layer's QuantDense amax history would roll once per TICK
-        # instead of once per step under the staged encoder, silently
-        # changing the delayed-scaling semantics vs pp=1 — refuse
-        # loudly; named ROADMAP follow-on next to the decode
-        # unquantized-checkpoint caveat.
+    # quant composes since r23: the staged encoder threads a
+    # PipelineTickCtx amax cadence through QuantDense so each site's
+    # history rolls once per optimizer STEP (quantizing every tick at
+    # the pre-step scale and folding the per-microbatch amaxes into one
+    # max — bitwise the full-batch amax), instead of the per-tick rolls
+    # that made r22 refuse.  The cadence is schedule-independent (every
+    # chunk invocation per tick is either real or bubble under 1f1b and
+    # interleaved alike), so the old refusal is gone entirely; scale-
+    # state parity vs pp=1 is pinned by tests/test_pp_residency.py.
+    # The ONE remaining refusal: --remat.  nn.remat makes every tick's
+    # layer call its own checkpoint trace, so the cadence's cross-tick
+    # history stash would leak tracers between traces — the staged
+    # branch disables the ctx under remat, which would silently restore
+    # the per-tick rolls r22 refused.  Refuse loudly instead.
+    remat = bool(getattr(cfg, "remat", False))
+    if getattr(cfg, "quant", "none") not in (None, "", "none") and remat:
         raise ValueError(
-            f"--quant {cfg.quant} does not compose with pipeline "
-            f"parallelism yet (per-tick amax updates would diverge from "
-            f"the pp=1 delayed-scaling schedule); train unquantized on "
-            f"pp meshes")
-    if (getattr(cfg, "dropout_impl", "none") or "none") != "none":
-        # dropout stays VALID on a pp mesh (an independent mask
-        # stream), but the staged encoder's make_rng fold count differs
-        # from pp=1 and bubble slots consume draws — so pp>1 vs pp=1
-        # runs are only comparable in distribution, not the documented
-        # allclose class (module docstring).  Warn, don't refuse.
-        import warnings
-        warnings.warn(
-            f"pp={stages} with dropout_impl={cfg.dropout_impl!r}: the "
-            f"staged encoder draws a different dropout stream than "
-            f"pp=1 (per-tick make_rng folds, bubble-slot draws) — the "
-            f"pp ≡ pp=1 parity contract holds only with dropout "
-            f"disabled (--dropout_impl none)",
-            stacklevel=2)
+            f"--quant with pp={stages} and --remat: the per-step amax "
+            f"cadence that makes delayed scaling match pp=1 cannot "
+            f"cross nn.remat's per-tick checkpoint traces; drop --remat "
+            f"on pp meshes with quant, or train unquantized")
+    impl = (getattr(cfg, "dropout_impl", "none") or "none")
+    if impl != "none":
+        attn = (attention_impl if attention_impl is not None
+                else (getattr(cfg, "attention", "") or ""))
+        # hash-engine dropout composes since r23: the staged encoder
+        # threads PipelineTickCtx through the FastDropout sites and the
+        # dense attention path — per-site seeds stashed at the first
+        # (fold-count-0) make_rng draw, each microbatch offset to its
+        # GLOBAL rows of the hash index stream — so pp ≡ pp=1 holds
+        # with dropout LIVE for the hash engine on dense attention with
+        # the flax FFN.  The remaining non-parity combos keep a warning:
+        # "xla" (threefry masks fold per invocation), the pallas fused
+        # FFN (in-kernel rows address the microbatch-local index
+        # space), and the flash/ring/ulysses kernels (dropout streams
+        # keyed on local (b,h) inside their scan/shard_map).
+        parity = (impl == "hash"
+                  and (getattr(cfg, "ffn_impl", "flax") or "flax")
+                  != "pallas"
+                  and attn == "dense"
+                  and not remat)
+        if not parity:
+            import warnings
+            warnings.warn(
+                f"pp={stages} with dropout_impl={impl!r}, "
+                f"attention={attn or 'auto'!r}, "
+                f"ffn_impl={getattr(cfg, 'ffn_impl', 'flax')!r}, "
+                f"remat={remat}: this "
+                f"combination draws a different dropout stream than "
+                f"pp=1 — still valid dropout, but the pp ≡ pp=1 parity "
+                f"class needs the hash engine on dense attention with "
+                f"the flax FFN, no remat (or --dropout_impl none)",
+                stacklevel=2)
     schedule = getattr(cfg, "pp_schedule", "1f1b") or "1f1b"
     m = resolve_microbatches(cfg.batch_size, stages,
                              int(getattr(cfg, "pp_microbatches", 0) or 0))
@@ -349,9 +510,9 @@ def pipeline_rules(spec: Optional[PipelineSpec], cfg=None) -> dict:
         "stages": [
             {"stage": s,
              "layers": [f"layer_{i}" for i in layers],
-             # embedding/head are un-staged (replicated over pp, like
-             # every param — see param_placement below); the table
-             # records their logical home for the memory follow-on
+             # embedding/head are un-staged (replicated over pp — see
+             # param_residency below); the table records their logical
+             # home so per-stage accounting can attribute them
              "extra": (["embeddings"] if s == 0 else [])
              + (["ln_final", "head"] if s == spec.n_stages - 1 else [])}
             for s, layers in enumerate(spec.stage_layers)],
@@ -361,9 +522,42 @@ def pipeline_rules(spec: Optional[PipelineSpec], cfg=None) -> dict:
         "boundary_collective":
             "collective-permute over pp (the DCN hop), one "
             "[B/M, L, d] activation per tick",
-        "param_placement":
-            "replicated over pp (dp/fsdp/tp/zero specs unchanged per "
-            "stage — physical per-stage residency is the named "
-            "live-TPU ROADMAP follow-on)",
+        "param_residency": _param_residency_rules(spec, cfg),
         "batch_axes": "dp/fsdp only (pp never shards the batch)",
+    }
+
+
+def _param_residency_rules(spec: PipelineSpec, cfg=None) -> dict:
+    """The per-stage residency block of the rule table (ISSUE 19
+    tentpole): which leaf classes live on their pp coordinate, which
+    replicate and why — sharding.py's PP registries plus the stage-home
+    assignment, in one inspectable record.  ``enabled`` reflects
+    cfg.pp_residency (--no_pp_residency restores the r22 replicated-
+    over-pp layout, e.g. for pp on a single slice where HBM is shared
+    anyway — see README's decision table)."""
+    from faster_distributed_training_tpu.parallel.sharding import (
+        PP_RESIDENCY_RULES, REPLICATED_PP_PARAMS, ZERO_MIN_SIZE)
+    enabled = bool(getattr(cfg, "pp_residency", True)) if cfg is not None \
+        else True
+    return {
+        "enabled": enabled,
+        "axis": "pp",
+        "min_size": ZERO_MIN_SIZE,
+        # every param/opt-state/batch_stats leaf resolves its stage
+        # home through param_stage_home; stage-owned leaves shard over
+        # pp (optimizer mirrors inherit via the param_mirror rule,
+        # multiplying with ZeRO-within-a-stage), the rest replicate
+        # with a registered reason:
+        "sharded": dict(PP_RESIDENCY_RULES) if enabled else {},
+        "replicated": (dict(REPLICATED_PP_PARAMS) if enabled else {
+            "all": "pp_residency disabled (--no_pp_residency): params "
+                   "and optimizer state keep the r22 replicated-over-pp "
+                   "layout"}),
+        "stage_home": {
+            **{f"layer_{i}": s
+               for s, layers in enumerate(spec.stage_layers)
+               for i in layers},
+            "embeddings": 0,
+            "head": spec.n_stages - 1,
+        },
     }

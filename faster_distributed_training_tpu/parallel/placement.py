@@ -44,12 +44,22 @@ def dp_size(mesh: Mesh) -> int:
     return max(n, 1)
 
 
-def train_state_shardings(state, mesh: Mesh, cfg: TrainConfig):
+def train_state_shardings(state, mesh: Mesh, cfg: TrainConfig,
+                          pipeline=None):
     """A TrainState-shaped pytree of NamedSharding.
 
     Model params additionally get tensor-parallel specs wherever a
     sharding._TP_RULES name rule matches, when the mesh has a tp axis of
-    size > 1 (TP wins over the FSDP spec on matched tensors)."""
+    size > 1 (TP wins over the FSDP spec on matched tensors).
+
+    With ``pipeline`` (a parallel.pipeline.PipelineSpec) and
+    cfg.pp_residency, stage-owned leaves additionally land on their pp
+    coordinate (sharding.pp_residency_specs — ISSUE 19): each stage's
+    chips hold 1/pp of the layer params, composing with the tp overlay
+    (the pp entry only takes a FREE axis).  The ZeRO opt-state overlay
+    then runs over tp when present, else over pp — and its param_mirror
+    rule inherits the pp'd param specs either way, so a dp x tp x pp
+    mesh multiplies both reductions."""
     if cfg.fsdp and "fsdp" in mesh.axis_names:
         specs = fsdp_partition_params(state, mesh, axis="fsdp")
     elif cfg.zero1:
@@ -66,9 +76,13 @@ def train_state_shardings(state, mesh: Mesh, cfg: TrainConfig):
                                                 axis=ax))
     else:
         specs = jax.tree.map(lambda _: P(), state)
-    if "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
+    tp_live = "tp" in mesh.axis_names and mesh.shape["tp"] > 1
+    pp_live = (pipeline is not None
+               and getattr(cfg, "pp_residency", True)
+               and "pp" in mesh.axis_names and mesh.shape["pp"] > 1)
+    if tp_live:
         from faster_distributed_training_tpu.parallel.sharding import (
-            param_path_name, tensor_parallel_rules, zero_opt_state_specs)
+            param_path_name, tensor_parallel_rules)
 
         def overlay(path, spec):
             tp_spec = tensor_parallel_rules(param_path_name(path))
@@ -78,19 +92,46 @@ def train_state_shardings(state, mesh: Mesh, cfg: TrainConfig):
             overlay, specs.params["model"],
             is_leaf=lambda x: isinstance(x, P))
         specs = specs.replace(params={**specs.params, "model": model_specs})
-        if getattr(cfg, "zero_opt", True):
-            # ZeRO over tp (ISSUE 16 tentpole): the FULL optimizer state
-            # joins the overlay — shape-aware rules, because NGD factor
-            # states don't mirror param shapes.  The zero spec wins over
-            # the base fsdp/zero1 spec wherever a rule matched.
-            zspecs = zero_opt_state_specs(
-                state.opt_state, state.params, specs.params, mesh,
-                axis="tp")
-            merged = jax.tree.map(
-                lambda z, base: z if z != P() else base,
-                zspecs, specs.opt_state,
-                is_leaf=lambda x: isinstance(x, P))
-            specs = specs.replace(opt_state=merged)
+    if pp_live:
+        # per-stage residency (ISSUE 19): runs AFTER the tp overlay so
+        # tp-occupied axes are off-limits; the fsdp/zero1 base specs'
+        # axes are respected the same way
+        from faster_distributed_training_tpu.parallel.sharding import (
+            pp_residency_specs)
+        model_specs = pp_residency_specs(
+            state.params["model"], specs.params["model"], pipeline, mesh)
+        specs = specs.replace(params={**specs.params, "model": model_specs})
+        # the opt-state mirrors of stage-owned params must follow them
+        # onto their pp coordinate even with --no_zero_opt — otherwise
+        # the (2-3x larger) optimizer fraction silently stays replicated
+        from faster_distributed_training_tpu.parallel.sharding import (
+            mirror_param_specs)
+        mspecs = mirror_param_specs(
+            state.opt_state, state.params, specs.params)
+        specs = specs.replace(opt_state=jax.tree.map(
+            lambda m, base: m if m != P() else base,
+            mspecs, specs.opt_state,
+            is_leaf=lambda x: isinstance(x, P)))
+    zero_axis = "tp" if tp_live else ("pp" if pp_live else None)
+    if zero_axis is not None and getattr(cfg, "zero_opt", True):
+        # ZeRO over the model axis (ISSUE 16; extended to pp-only
+        # meshes by ISSUE 19): the FULL optimizer state joins the
+        # overlay — shape-aware rules, because NGD factor states don't
+        # mirror param shapes.  param_mirror leaves inherit the
+        # (tp+pp-overlaid) param specs, so stage-owned mirrors land on
+        # their pp coordinate even when the roll axis is tp.  The zero
+        # spec wins over the base fsdp/zero1 spec wherever a rule
+        # matched.
+        from faster_distributed_training_tpu.parallel.sharding import (
+            zero_opt_state_specs)
+        zspecs = zero_opt_state_specs(
+            state.opt_state, state.params, specs.params, mesh,
+            axis=zero_axis)
+        merged = jax.tree.map(
+            lambda z, base: z if z != P() else base,
+            zspecs, specs.opt_state,
+            is_leaf=lambda x: isinstance(x, P))
+        specs = specs.replace(opt_state=merged)
     shardings = jax.tree.map(lambda spec: NamedSharding(mesh, spec), specs,
                              is_leaf=lambda x: isinstance(x, P))
     offloadable = _supports_memory_kind(mesh)

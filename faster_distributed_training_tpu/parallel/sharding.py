@@ -309,6 +309,146 @@ def zero_opt_state_specs(opt_state: Any, params: Any, param_specs: Any,
     return jax.tree_util.tree_map_with_path(per_leaf, opt_state)
 
 
+# ---------------------------------------------------------------------------
+# Per-stage parameter residency over pp (ISSUE 19 tentpole)
+# ---------------------------------------------------------------------------
+#
+# r22 left every param replicated over pp, so a 4-stage model still had
+# to fit one slice's HBM.  The overlay below gives stage-owned leaves —
+# params under a ``layer_{i}`` subtree, whose stage home
+# pipeline.param_stage_home reads off the ONE rule table — a 'pp' entry
+# on a free axis of their (tp/fsdp-overlaid) spec, so each stage's
+# chips hold 1/pp of the layer weights and (through the param_mirror
+# inheritance in classify_opt_state_leaf) 1/pp of their optimizer
+# mirrors.  Values are untouched: GSPMD materializes a leaf at use from
+# its shards, so pp=2 ≡ pp=1 parity and the bitwise checkpoint
+# interchange (specs live in the restore template, never the arrays)
+# both survive.  The registries are the inspectable spec, enforced by
+# scripts/check_sharding_rules.py exactly like OPT_STATE_RULES: a new
+# param leaf class cannot silently re-replicate over pp.
+#
+# Honest scope note (the CPU-measurable claim): this is RESIDENCY —
+# bytes at rest per chip scale with 1/pp, which is what the
+# pp_param_bytes_per_chip bench arms measure.  On the steady path the
+# unrolled tick loop applies each layer once per tick, and GSPMD
+# gathers a stage's shard set at first use and CSEs the gather across
+# ticks (ZeRO-3-class traffic, one gather per layer per step); the
+# real-HBM/real-DCN traffic read is the live-TPU carryover item in
+# ROADMAP.md.
+
+PP_RESIDENCY_RULES: Dict[str, str] = {
+    "stage_owned":
+        "param under layer_{i} (pipeline.param_stage_home maps i to its "
+        "stage) — 'pp' added on the largest free axis (one not already "
+        "carrying fsdp/tp) divisible by the pp size; optimizer mirrors "
+        "inherit the spec via classify_opt_state_leaf's param_mirror "
+        "rule, multiplying the ZeRO reduction on dp x tp x pp meshes",
+}
+
+# param leaf classes that stay replicated over pp ON PURPOSE, with the
+# registered reason the lint requires (the REPLICATED_OPT_STATE idiom).
+REPLICATED_PP_PARAMS: Dict[str, str] = {
+    "shared_embed":
+        "embedding tables (token/pos/segment) — consumed by stage 0's "
+        "input assembly and (tied LM head) the last stage's logits, so "
+        "no single stage owns them; logical home stage 0",
+    "shared_head":
+        "ln_final / pooler / classifier / lm_head — applied after the "
+        "staged encoder on the reassembled full batch; logical home is "
+        "the last stage",
+    "pp_small":
+        f"stage-owned but fewer than {ZERO_MIN_SIZE} elements (LN "
+        "scales/biases) — sharding a bias-sized leaf just adds "
+        "collective latency (same floor as FSDP/ZeRO)",
+    "pp_indivisible":
+        "stage-owned but no free axis divisible by the pp size — "
+        "padding would break the bitwise checkpoint interchange",
+    "pp_unmatched":
+        "param_stage_home recognized neither a layer home nor a shared "
+        "role — conservatively replicated; "
+        "scripts/check_sharding_rules.py fails until a rule (or an "
+        "explicit entry here) covers the new leaf class",
+}
+
+
+def classify_pp_param_leaf(role: str, shape, base_spec: P, n: int,
+                           axis: str = "pp",
+                           min_size: int = ZERO_MIN_SIZE
+                           ) -> Tuple[str, P]:
+    """(class name, PartitionSpec) for one param leaf under per-stage
+    residency.  ``role`` is pipeline.param_stage_home's verdict
+    ('stage_owned' / 'shared_embed' / 'shared_head' / 'unknown');
+    ``base_spec`` the leaf's existing (fsdp/tp-overlaid) spec, whose
+    occupied axes are off-limits.  Only stage-owned leaves shard: the
+    'pp' entry lands on the largest FREE axis divisible by ``n``."""
+    shape = tuple(shape)
+    if role in ("shared_embed", "shared_head"):
+        return role, base_spec
+    if role != "stage_owned":
+        return "pp_unmatched", base_spec
+    if not shape or int(np.prod(shape)) < min_size:
+        return "pp_small", base_spec
+    entries = tuple(base_spec) + (None,) * (len(shape) - len(base_spec))
+    best, best_dim = None, 0
+    for i, d in enumerate(shape):
+        if entries[i] is None and d % n == 0 and d > best_dim:
+            best, best_dim = i, d
+    if best is None:
+        return "pp_indivisible", base_spec
+    out = list(entries)
+    out[best] = axis
+    return "stage_owned", P(*out)
+
+
+def pp_residency_specs(params: Any, base_specs: Any, pipeline,
+                       mesh: Mesh, min_size: int = ZERO_MIN_SIZE) -> Any:
+    """Overlay per-stage residency onto the model-param spec tree:
+    stage-owned leaves (per ``pipeline``'s rule table) gain a 'pp'
+    entry per classify_pp_param_leaf; everything else keeps its base
+    spec.  Identity when the mesh has no pp axis of size > 1."""
+    if "pp" not in mesh.axis_names or mesh.shape["pp"] <= 1:
+        return base_specs
+    from faster_distributed_training_tpu.parallel.pipeline import (
+        param_stage_home)
+    n = mesh.shape["pp"]
+
+    def per_leaf(path, leaf, base):
+        role, _ = param_stage_home(pipeline, param_path_name(path))
+        _, spec = classify_pp_param_leaf(role, np.shape(leaf), base, n,
+                                         min_size=min_size)
+        return spec
+
+    return jax.tree_util.tree_map_with_path(
+        per_leaf, params, base_specs)
+
+
+def mirror_param_specs(opt_state: Any, params: Any,
+                       param_specs: Any) -> Any:
+    """Spec pytree placing each opt-state PARAM-MIRROR leaf (optax
+    trace/adam mu,nu/madgrad s,v,z — recognized exactly like
+    classify_opt_state_leaf: keystr suffix match + shape agreement) on
+    its param's spec; P() everywhere else.
+
+    This is the residency slice of the ZeRO overlay factored out so
+    placement can apply it on pp meshes even under --no_zero_opt: a
+    stage-owned param whose adam moments stay replicated would cap HBM
+    at one slice's optimizer state, silently undoing the r23 tentpole
+    for the (much larger) opt-state fraction.  When the full ZeRO
+    overlay also runs it agrees on every mirror leaf (same suffix
+    table, same inheritance), so applying both is idempotent."""
+    suffixes = _param_suffix_table(params, param_specs)
+
+    def per_leaf(path, leaf):
+        key = jax.tree_util.keystr(path)
+        shape = tuple(np.shape(leaf))
+        for pkey, (pshape, pspec) in suffixes.items():
+            if key.endswith(pkey) and shape == tuple(pshape):
+                return pspec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(per_leaf, opt_state)
+
+
 # elements below this stay on device even under --offload_opt_state:
 # streaming a bias-sized slot over PCIe costs more latency than the
 # HBM it frees.  64Ki elements ~= 256 KB fp32.

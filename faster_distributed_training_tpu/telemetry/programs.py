@@ -492,7 +492,8 @@ STATE_MEMORY_FIELDS = (
     "scope", "params_bytes_per_chip", "params_leaves",
     "opt_state_bytes_per_chip", "opt_state_leaves",
     "batch_stats_bytes_per_chip", "batch_stats_leaves",
-    "total_bytes_per_chip", "top_leaves", "opt_state_tiers")
+    "total_bytes_per_chip", "top_leaves", "opt_state_tiers",
+    "pp_residency")
 
 
 def leaf_bytes_per_chip(leaf) -> int:
@@ -505,6 +506,25 @@ def leaf_bytes_per_chip(leaf) -> int:
         dev = shards[0].device
         return int(sum(s.data.nbytes for s in shards if s.device == dev))
     return int(getattr(leaf, "nbytes", 0))
+
+
+def leaf_spec_axes(leaf) -> set:
+    """The set of mesh axis names a live leaf's PartitionSpec uses
+    (tuple entries flattened); empty for replicated/host leaves.  The
+    r23 pp-residency column of the HBM table is built from it."""
+    sh = getattr(leaf, "sharding", None)
+    spec = getattr(sh, "spec", None)
+    axes: set = set()
+    if spec is None:
+        return axes
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.update(a for a in entry if a)
+        else:
+            axes.add(entry)
+    return axes
 
 
 def leaf_tier(leaf) -> str:
@@ -540,15 +560,25 @@ def state_bytes_table(state, top: int = 5) -> dict:
     sized: List[Tuple[int, str, str]] = []
     total = 0
     tiers: Dict[str, Dict[str, int]] = {}
+    # r23 per-stage residency column: how many leaves of each group
+    # actually occupy a pp coordinate, and how many bytes one chip
+    # holds for them — the per-run record that ~1/S of the stage-owned
+    # state lives on each stage (all zeros on every pp=1 or
+    # --no_pp_residency run)
+    ppres: Dict[str, Dict[str, int]] = {}
     for group in ("params", "opt_state", "batch_stats"):
         tree = getattr(state, group, None)
         flat = jax.tree_util.tree_flatten_with_path(tree)[0]
         b = 0
+        pp_leaves = pp_bytes = 0
         for path, leaf in flat:
             n = leaf_bytes_per_chip(leaf)
             b += n
             tier = leaf_tier(leaf)
             sized.append((n, group + jax.tree_util.keystr(path), tier))
+            if "pp" in leaf_spec_axes(leaf):
+                pp_leaves += 1
+                pp_bytes += n
             if group == "opt_state":
                 agg = tiers.setdefault(tier,
                                        {"leaves": 0, "bytes_per_chip": 0})
@@ -556,12 +586,14 @@ def state_bytes_table(state, top: int = 5) -> dict:
                 agg["bytes_per_chip"] += n
         out[f"{group}_bytes_per_chip"] = b
         out[f"{group}_leaves"] = len(flat)
+        ppres[group] = {"leaves": pp_leaves, "bytes_per_chip": pp_bytes}
         total += b
     out["total_bytes_per_chip"] = total
     out["top_leaves"] = [
         {"path": p, "bytes_per_chip": n, "tier": t}
         for n, p, t in sorted(sized, reverse=True)[:top]]
     out["opt_state_tiers"] = tiers
+    out["pp_residency"] = ppres
     return out
 
 

@@ -165,7 +165,8 @@ TELEMETRY_SCHEMA: Dict[str, Optional[frozenset]] = {
                          "opt_state_bytes_per_chip", "opt_state_leaves",
                          "batch_stats_bytes_per_chip",
                          "batch_stats_leaves", "total_bytes_per_chip",
-                         "top_leaves", "opt_state_tiers", "peak_bytes",
+                         "top_leaves", "opt_state_tiers", "pp_residency",
+                         "peak_bytes",
                          "bytes_in_use", "expected", "got",
                          "changed_leaves"}),
     "flight": frozenset({"path", "reason"}),

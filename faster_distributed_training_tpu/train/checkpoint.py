@@ -143,6 +143,27 @@ def opt_state_layout(state) -> dict:
         return {}
 
 
+def params_layout(state) -> dict:
+    """opt_state_layout's twin over state.params (r23 per-stage
+    residency: pp-sharded params are the first layout where PARAMS can
+    be sharded without fsdp).  Same contract: {} unless some leaf is
+    actually sharded/offloaded, {} on any failure — meta stays
+    byte-identical for every replicated-param checkpoint ever
+    written."""
+    try:
+        from faster_distributed_training_tpu.telemetry.programs import (
+            leaf_tier)
+        tiers: dict = {}
+        for leaf in jax.tree.leaves(state.params):
+            t = leaf_tier(leaf)
+            tiers[t] = tiers.get(t, 0) + 1
+        if not (tiers.get("sharded") or tiers.get("offloaded")):
+            return {}
+        return tiers
+    except Exception:
+        return {}
+
+
 def save_checkpoint(checkpoint_dir: str, name: str, state: TrainState,
                     epoch: int, best_acc: float,
                     extra_meta: Optional[dict] = None) -> str:
@@ -153,10 +174,12 @@ def save_checkpoint(checkpoint_dir: str, name: str, state: TrainState,
     manager's async path saves a device_get snapshot this way."""
     path = _ckpt_dir(checkpoint_dir, name)
     layout = opt_state_layout(state)
+    players = params_layout(state)
     return save_pytree_checkpoint(
         path, _state_pytree(state),
         {"epoch": int(epoch), "best_acc": float(best_acc),
          **({"opt_state_layout": layout} if layout else {}),
+         **({"params_layout": players} if players else {}),
          **(extra_meta or {})})
 
 
@@ -224,6 +247,13 @@ def restore_checkpoint(checkpoint_dir: str, name: str, state: TrainState
               f"checkpoint was written with {saved_layout}, restoring "
               f"into {live_layout} — values re-placed by the template "
               f"shardings (ZeRO<->replicated interchange)")
+    saved_players = meta.get("params_layout")
+    live_players = params_layout(state)
+    if saved_players and live_players and saved_players != live_players:
+        print(f"[ckpt] params layout changed across restore: "
+              f"checkpoint was written with {saved_players}, restoring "
+              f"into {live_players} — values re-placed by the template "
+              f"shardings (pp-residency<->replicated interchange)")
     epoch = int(meta.get("epoch", 0))
     best_acc = float(meta.get("best_acc", 0.0))
     state = state.replace(

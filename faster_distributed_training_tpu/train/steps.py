@@ -164,6 +164,12 @@ def make_train_step(cfg: TrainConfig, state_shardings=None, pipeline=None
         # --offload_opt_state on a host-only backend is BITWISE inert
         # (pinned by test_offload_opt_state_degrades_bitwise_on_cpu).
         offload = False
+    # constrain_out is also what makes r23 per-stage residency STICK:
+    # the updated state is pinned to the train_state_shardings tree
+    # (which carries the pp specs from sharding.pp_residency_specs), so
+    # the partitioner cannot drift a stage-owned leaf back to
+    # replicated between donated steps — the same pin that already
+    # protects the tp/sp layouts below.
     constrain_out = state_shardings is not None and not offload
     fetch, stash = _offload_transfers(
         state_shardings if offload else None)

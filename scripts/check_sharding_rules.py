@@ -19,6 +19,14 @@ HBM win silently erodes.  Enforced (tests/test_zero_sharding.py):
      least one probe leaf (the registry cannot rot into fiction);
   3. the two registries must be disjoint (one name, one story).
 
+r23 (ISSUE 19) adds the PP residency registries to the same contract:
+``PP_RESIDENCY_RULES`` / ``REPLICATED_PP_PARAMS`` classify every PARAM
+leaf of a pipelined transformer through ``pipeline.param_stage_home`` +
+``classify_pp_param_leaf`` — a new top-level param class that neither
+maps to a stage nor to a registered shared role classifies
+'pp_unmatched' and FAILS here, so per-stage residency cannot silently
+erode back to replicated-over-pp.
+
 Run:  python scripts/check_sharding_rules.py   (exit 0 = clean)
 """
 
@@ -103,10 +111,65 @@ def classify_all(n: int = PROBE_AXIS_SIZE
     return rows
 
 
+def _probe_pp_params():
+    """A pipelined-transformer-shaped MODEL param tree: per-layer
+    kernels (big + divisible, big + indivisible, sub-floor LN), the
+    shared embedding tables and the post-encoder head leaves, plus an
+    unknown top-level class that must FAIL classification."""
+    import jax.numpy as jnp
+
+    return {
+        "Embeddings_0": {"token_embedding": jnp.zeros((1000, 64)),
+                         "pos_embedding": jnp.zeros((128, 64))},
+        "layer_0": {"attn": {"qkv": {"kernel": jnp.zeros((64, 3, 4, 16)),
+                                     "bias": jnp.zeros((3, 4, 16))}},
+                    "ffn": {"Dense_0": {"kernel": jnp.zeros((64, 128))}},
+                    "ln_attn": {"scale": jnp.ones((64,))},
+                    "odd": {"kernel": jnp.zeros((1025, 7))}},
+        "layer_1": {"ffn": {"Dense_1": {"kernel": jnp.zeros((128, 64))}}},
+        "ln_final": {"scale": jnp.ones((64,))},
+        "pooler": {"kernel": jnp.zeros((64, 64))},
+        "cls_w1": jnp.zeros((128, 64)),
+        "lm_head": {"kernel": jnp.zeros((64, 1000))},
+    }
+
+
+def classify_pp_all(n: int = PROBE_AXIS_SIZE,
+                    include_unknown: bool = True
+                    ) -> List[Tuple[str, tuple, str]]:
+    """(leaf keystr, shape, classified name) for every probe PARAM leaf
+    under per-stage residency.  ``include_unknown`` adds a leaf no rule
+    recognizes (the tier-1 lint test asserts it is CAUGHT; check()
+    excludes it so a clean repo exits 0)."""
+    import jax
+    import numpy as np
+
+    from faster_distributed_training_tpu.parallel.pipeline import (
+        PipelineSpec, param_stage_home, partition_stages)
+    from faster_distributed_training_tpu.parallel.sharding import (
+        classify_pp_param_leaf, param_path_name)
+    from jax.sharding import PartitionSpec as P
+
+    params = _probe_pp_params()
+    if include_unknown:
+        import jax.numpy as jnp
+        params["mystery_adapter"] = {"kernel": jnp.zeros((64, 64))}
+    spec = PipelineSpec(n_layers=2, n_stages=2, n_microbatches=4,
+                        stage_layers=partition_stages(2, 2))
+    rows = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        flat = param_path_name(path)
+        role, _ = param_stage_home(spec, flat)
+        name, _ = classify_pp_param_leaf(role, np.shape(leaf), P(), n)
+        rows.append((flat, tuple(np.shape(leaf)), name))
+    return rows
+
+
 def check(n: int = PROBE_AXIS_SIZE) -> List[str]:
     """All rule-coverage problems found, [] when clean."""
     from faster_distributed_training_tpu.parallel.sharding import (
-        OPT_STATE_RULES, REPLICATED_OPT_STATE)
+        OPT_STATE_RULES, PP_RESIDENCY_RULES, REPLICATED_OPT_STATE,
+        REPLICATED_PP_PARAMS)
 
     problems: List[str] = []
 
@@ -139,6 +202,43 @@ def check(n: int = PROBE_AXIS_SIZE) -> List[str]:
                 f"probe opt-state leaf — the registry rotted (or the "
                 f"probe trees in scripts/check_sharding_rules.py need a "
                 f"new case)")
+
+    # -- pp residency (r23): the same three rules over the PARAM
+    #    registries, classified through the pipeline stage-home table
+    pp_overlap = set(PP_RESIDENCY_RULES) & set(REPLICATED_PP_PARAMS)
+    for name in sorted(pp_overlap):
+        problems.append(
+            f"rule 3: {name!r} appears in BOTH PP_RESIDENCY_RULES and "
+            f"REPLICATED_PP_PARAMS — one name, one story")
+    pp_known: Set[str] = set(PP_RESIDENCY_RULES) | set(REPLICATED_PP_PARAMS)
+    pp_hit: Dict[str, int] = {}
+    for key, shape, name in classify_pp_all(n, include_unknown=False):
+        pp_hit[name] = pp_hit.get(name, 0) + 1
+        if name == "pp_unmatched":
+            problems.append(
+                f"rule 1: param leaf {key} {shape} classified "
+                f"'pp_unmatched' — extend pipeline.param_stage_home (or "
+                f"register an explicit replicate-with-reason entry in "
+                f"sharding.REPLICATED_PP_PARAMS) for this leaf class")
+        elif name not in pp_known:
+            problems.append(
+                f"rule 1: param leaf {key} {shape} classified into "
+                f"unregistered class {name!r} — classify_pp_param_leaf "
+                f"and the PP registries drifted apart")
+    for name in sorted(pp_known - {"pp_unmatched"}):
+        if not pp_hit.get(name):
+            problems.append(
+                f"rule 2: PP registry entry {name!r} is exercised by no "
+                f"probe param leaf — the registry rotted (or "
+                f"_probe_pp_params needs a new case)")
+    # the unknown-leaf catch itself must keep working (an unregistered
+    # stage-owned/top-level class CANNOT silently replicate)
+    caught = [name for _, _, name in classify_pp_all(n)
+              if name == "pp_unmatched"]
+    if not caught:
+        problems.append(
+            "rule 1: the unknown-leaf probe ('mystery_adapter') was NOT "
+            "classified 'pp_unmatched' — the lint lost its catch")
     return problems
 
 
@@ -151,7 +251,8 @@ def main() -> int:
         return 1
     print("[sharding-rules] clean: every opt-state leaf class of every "
           "registered optimizer matches a sharding rule or a documented "
-          "replicate-with-reason entry")
+          "replicate-with-reason entry; every pipelined-transformer "
+          "param leaf resolves a pp residency class")
     return 0
 
 

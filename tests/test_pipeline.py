@@ -195,17 +195,29 @@ class TestScheduleUnits:
                                                   jax.devices()[:2])) is None
         with pytest.raises(ValueError, match="no staged form"):
             build_pipeline_spec(cfg.replace(model="resnet18"), mesh)
-        # quant + pp refuses loudly (per-tick amax would diverge from
-        # the pp=1 delayed-scaling schedule; named ROADMAP follow-on)
-        with pytest.raises(ValueError, match="does not compose"):
-            build_pipeline_spec(cfg.replace(quant="int8"), mesh)
-        # a live dropout impl warns (different RNG stream than pp=1 —
-        # the parity contract holds with dropout disabled only) ...
+        # quant + pp composes since r23 (the PipelineTickCtx per-step
+        # amax cadence; scale-state parity pinned in
+        # tests/test_pp_residency.py) — only the remat combination
+        # still refuses: the cadence's cross-tick history stash cannot
+        # cross nn.remat's per-tick checkpoint traces
+        spec_q = build_pipeline_spec(cfg.replace(quant="int8"), mesh)
+        assert spec_q is not None and spec_q.n_stages == 2
+        with pytest.raises(ValueError, match="remat"):
+            build_pipeline_spec(cfg.replace(quant="int8", remat=True),
+                                mesh)
+        # non-parity dropout combos still warn: xla (threefry masks
+        # fold per invocation) and hash under AUTO attention (the
+        # resolved kernel is unknown, treated conservatively) ...
+        with pytest.warns(UserWarning, match="dropout"):
+            build_pipeline_spec(cfg.replace(dropout_impl="xla"), mesh)
         with pytest.warns(UserWarning, match="dropout"):
             build_pipeline_spec(cfg.replace(dropout_impl="hash"), mesh)
-        # ... and dropout_impl=none stays silent
+        # ... but the r23 parity combo (hash engine + dense attention +
+        # flax FFN, no remat) and dropout_impl=none stay silent
         with warnings.catch_warnings():
             warnings.simplefilter("error")
+            build_pipeline_spec(cfg.replace(dropout_impl="hash",
+                                            attention="dense"), mesh)
             build_pipeline_spec(cfg.replace(dropout_impl="none"), mesh)
 
     def test_rule_table_shapes(self):
