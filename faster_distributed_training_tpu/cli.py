@@ -828,6 +828,14 @@ def run_training(cfg: TrainConfig,
                              "--data_path stream (the window refill "
                              "addresses the full on-disk index space); "
                              "shard a smaller dataset instead")
+    if cfg.dataset == "stream":
+        # chaos arm FDT_FAULT_CORRUPT_SHARD (resilience/faults.py): flip
+        # bytes inside one committed shard file BEFORE the reader opens
+        # its mmaps — the manifest sizes still match, so only the CRC
+        # screen (data/stream/reader.py) can catch it, which is the point
+        from faster_distributed_training_tpu.resilience.faults import (
+            apply_corrupt_shard_fault)
+        apply_corrupt_shard_fault(cfg.stream_dir, log=log)
     train_ds = apply_subset(load_dataset(cfg, train=True), cfg.subset_stride)
     eval_ds = apply_subset(load_dataset(cfg, train=False), cfg.subset_stride)
     if cfg.dataset == "stream" and is_text:
@@ -994,6 +1002,30 @@ def run_training(cfg: TrainConfig,
             f"{rounded} (multiple of steps_per_dispatch={k})")
         cfg = cfg.replace(checkpoint_every=rounded)
     res = build_resilience(cfg, log=log)
+    # stream-shard CRC quarantine events land in the sentinel's durable
+    # ledger + goodput counters (goodput-only when the sentinel is off —
+    # the reader warns + remaps regardless, see data/stream/reader.py)
+    if res is not None:
+        reader = (stream.dataset if stream is not None
+                  else train_ds if hasattr(train_ds, "on_quarantine")
+                  else None)
+        if reader is not None:
+            if res.sentinel is not None:
+                reader.on_quarantine = res.sentinel.quarantine_shard
+            else:
+                reader.on_quarantine = (
+                    lambda s, p: res.goodput.count("quarantined_shards"))
+    if (resident is not None
+            and getattr(resident, "upload_checksums", None)
+            and getattr(cfg, "sentinel", "none") == "full"):
+        # end-to-end upload integrity (--sentinel full): re-read the
+        # device-resident split and compare against the host-side
+        # checksums taken at encode time — once, before training, off
+        # the hot path (raises on mismatch; a corrupt upload must not
+        # train silently)
+        resident.verify_upload()
+        log("[sentinel] device-resident upload verified: post-upload "
+            "readback matches the host-side encode checksums")
     if res is not None and cfg.donate and jax.default_backend() == "cpu":
         # Measured (r7): on jaxlib 0.4.x's CPU client, a checkpoint
         # restore followed by donating the state back into the compiled

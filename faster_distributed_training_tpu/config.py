@@ -341,6 +341,29 @@ class TrainConfig:
                                       # (the reference's debug flag,
                                       # ngd_optimizer.py:46, which it never
                                       # turns on)
+    sentinel: str = "none"            # anomaly sentinel
+                                      # (resilience/sentinel.py):
+                                      # "none" = off (programs stay
+                                      # byte-identical to the unguarded
+                                      # build); "guard" = in-graph bad-step
+                                      # guard only (one fused non-finite
+                                      # check over loss + global grad norm;
+                                      # a poisoned step leaves params/
+                                      # opt-state/RNG bitwise-untouched and
+                                      # is counted as skipped_steps);
+                                      # "full" = guard + host-side
+                                      # loss-spike detector with rollback-
+                                      # and-quarantine (needs --supervise
+                                      # + --checkpoint_every for the
+                                      # rollback half — warned otherwise)
+    spike_window: int = 32            # sentinel "full": trailing window of
+                                      # per-dispatch losses the median/MAD
+                                      # spike statistic is computed over
+    spike_threshold: float = 8.0      # sentinel "full": a dispatch loss
+                                      # more than this many MADs above the
+                                      # window median is a spike (rollback
+                                      # + quarantine of the dispatch's
+                                      # global-batch indices)
 
     # -- resilience (resilience/ package; all off by default) --------------
     checkpoint_every: int = 0         # async step-cadence checkpoints every
@@ -503,6 +526,14 @@ class TrainConfig:
                                       # serve_replicas)
     decode_requests: int = 16         # built-in synthetic prompt count
                                       # for the CLI decode smoke
+    decode_deadline_s: float = 120.0  # decode front door: per-request
+                                      # wall deadline (assembly to
+                                      # completion, all retries
+                                      # included) — a request stranded
+                                      # by dying worker processes fails
+                                      # with TimeoutError after this
+                                      # instead of waiting forever;
+                                      # <=0 disables
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
@@ -678,6 +709,22 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--auto_recover", action="store_true",
                    help="on a non-finite epoch loss, restore the last good "
                         "checkpoint and keep training")
+    p.add_argument("--sentinel", default=d.sentinel,
+                   choices=["none", "guard", "full"],
+                   help="anomaly sentinel: 'guard' arms the in-graph "
+                        "bad-step guard (non-finite loss/grad-norm steps "
+                        "leave the state bitwise-untouched and are counted); "
+                        "'full' adds the host-side loss-spike detector with "
+                        "rollback-and-quarantine (wants --supervise + "
+                        "--checkpoint_every); 'none' keeps the programs "
+                        "byte-identical to the unguarded build")
+    p.add_argument("--spike_window", default=d.spike_window, type=int,
+                   help="sentinel full: trailing per-dispatch loss window "
+                        "for the median/MAD spike statistic")
+    p.add_argument("--spike_threshold", default=d.spike_threshold,
+                   type=float,
+                   help="sentinel full: MAD multiples above the window "
+                        "median that count as a loss spike")
     p.add_argument("--checkpoint_every", default=d.checkpoint_every, type=int,
                    help="async step-cadence checkpoints every N train steps "
                         "(keep-last-K, atomic commit markers, preemption-"
@@ -925,6 +972,13 @@ def build_parser(prog: str = "fdt",
     p.add_argument("--decode_requests", default=d.decode_requests,
                    type=int,
                    help="synthetic prompt count for the CLI decode smoke")
+    p.add_argument("--decode_deadline_s", default=d.decode_deadline_s,
+                   type=float,
+                   help="decode front door per-request wall deadline in "
+                        "seconds (all retries included); a request "
+                        "stranded by dying worker processes fails with "
+                        "TimeoutError after this instead of waiting "
+                        "forever (<=0 disables)")
     return p
 
 
@@ -985,6 +1039,9 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         log_every=args.log_every,
         plot=not args.no_plot,
         auto_recover=args.auto_recover, debug=args.debug,
+        sentinel=args.sentinel,
+        spike_window=args.spike_window,
+        spike_threshold=args.spike_threshold,
         checkpoint_every=args.checkpoint_every,
         checkpoint_every_secs=args.checkpoint_every_secs,
         checkpoint_keep=args.checkpoint_keep,
@@ -1024,6 +1081,7 @@ def config_from_args(args: argparse.Namespace, defaults: Optional[TrainConfig] =
         decode_top_k=args.decode_top_k,
         decode_replicas=args.decode_replicas,
         decode_requests=args.decode_requests,
+        decode_deadline_s=args.decode_deadline_s,
     )
     cfg = resolve_tricks(cfg)
     if args.model:

@@ -79,6 +79,45 @@ def _encode_split(data, max_len: int) -> Tuple[Dict[str, np.ndarray],
     return {"image": np.asarray(x), "label": np.asarray(y)}, False, 0, n
 
 
+def _host_checksums(host: Dict[str, np.ndarray]) -> Dict[str, int]:
+    """Per-leaf checksum of the encoded split at upload time (same
+    CRC32C definition as the stream shard files) — the resident path's
+    end of the data-integrity chain: a stream shard is CRC-verified at
+    gather, this tags what actually left the host, and
+    ``verify_upload()`` closes the loop against what HBM holds."""
+    from faster_distributed_training_tpu.data.stream.format import (
+        checksum_bytes)
+    return {k: checksum_bytes(np.ascontiguousarray(v))
+            for k, v in host.items()}
+
+
+def _verify_resident_upload(arrays: Dict[str, jax.Array], n: int,
+                            checksums: Dict[str, int]) -> bool:
+    """Fetch the resident arrays back from device and compare their
+    first ``n`` rows against the encode-time checksums; raises on
+    mismatch (an upload/DMA corruption — there is no sane way to
+    continue on poisoned training data already in HBM).  Multi-process
+    runs skip (each host holds only its row shard; the per-shard CRC at
+    gather already covered the bytes it contributed): returns False for
+    'not verified', True for verified."""
+    if not checksums:
+        return False
+    if jax.process_count() > 1:
+        return False
+    from faster_distributed_training_tpu.data.stream.format import (
+        checksum_bytes)
+    for k, want in checksums.items():
+        got = checksum_bytes(np.ascontiguousarray(
+            np.asarray(jax.device_get(arrays[k]))[:n]))
+        if got != want:
+            raise RuntimeError(
+                f"device-resident upload integrity failure: leaf {k!r} "
+                f"read back from HBM with checksum {got:#010x} != "
+                f"{want:#010x} computed at encode time — the uploaded "
+                f"split is corrupt; refusing to train on it")
+    return True
+
+
 class DeviceResidentData:
     """The train split as device arrays + per-epoch order uploads
     (the REPLICATED r8 layout — see module docstring).
@@ -94,7 +133,8 @@ class DeviceResidentData:
     batch_major = False
 
     def __init__(self, data, batch_size: int, seed: int = 0,
-                 max_len: int = 512, mesh=None, shuffle: bool = True):
+                 max_len: int = 512, mesh=None, shuffle: bool = True,
+                 checksum: bool = False):
         if jax.process_count() > 1:
             raise ValueError(
                 "replicated device residency is single-host only; "
@@ -110,6 +150,7 @@ class DeviceResidentData:
                 f"dataset ({self.n} samples) smaller than one batch "
                 f"({self.batch_size}) — nothing to train on")
         host, self.is_text, self.seq_len, _n = _encode_split(data, max_len)
+        self.upload_checksums = _host_checksums(host) if checksum else {}
         self.mesh = mesh
         self._replicated = None
         if mesh is not None:
@@ -130,6 +171,12 @@ class DeviceResidentData:
         static replicated split (the order indirection happens in-graph
         via ``epoch_order``)."""
         return self.arrays
+
+    def verify_upload(self) -> bool:
+        """Compare HBM contents against the encode-time checksums
+        (no-op False unless built with ``checksum=True``)."""
+        return _verify_resident_upload(self.arrays, self.n,
+                                       self.upload_checksums)
 
     def epoch_order(self, epoch: int) -> jax.Array:
         """The epoch's sample order as a device int32 array, truncated to
@@ -180,7 +227,8 @@ class ShardedDeviceResidentData:
     def __init__(self, data, batch_size: int, seed: int = 0,
                  max_len: int = 512, mesh=None, shuffle: bool = True,
                  process_index: Optional[int] = None,
-                 process_count: Optional[int] = None):
+                 process_count: Optional[int] = None,
+                 checksum: bool = False):
         if mesh is None:
             raise ValueError("sharded device residency requires the mesh "
                              "(its data axes define the row sharding)")
@@ -202,6 +250,7 @@ class ShardedDeviceResidentData:
         self.shuffle = bool(shuffle)
         host, self.is_text, self.seq_len, self.n = _encode_split(data,
                                                                  max_len)
+        self.upload_checksums = _host_checksums(host) if checksum else {}
         # the host loader's algebra: per-host shard of n // pc samples,
         # truncated to whole local batches
         self.steps_per_epoch = (self.n // self.pc) // self.local_bs
@@ -348,6 +397,13 @@ class ShardedDeviceResidentData:
         self._epoch_cache = (epoch, view, order)
         return view
 
+    def verify_upload(self) -> bool:
+        """Compare HBM contents (canonical row shards, pad trimmed)
+        against the encode-time checksums — single-process only; see
+        :func:`_verify_resident_upload`."""
+        return _verify_resident_upload(self.arrays, self.n,
+                                       self.upload_checksums)
+
 
 def build_device_resident(cfg, train_ds, mesh=None):
     """cfg-gated constructor: None (host path) unless
@@ -382,8 +438,11 @@ def build_device_resident(cfg, train_ds, mesh=None):
                 "sharded device residency needs a mesh; falling back to "
                 "the host data path", stacklevel=2)
             return None
-        return ShardedDeviceResidentData(train_ds, cfg.batch_size,
-                                         seed=cfg.seed, max_len=cfg.seq_len,
-                                         mesh=mesh)
-    return DeviceResidentData(train_ds, cfg.batch_size, seed=cfg.seed,
-                              max_len=cfg.seq_len, mesh=mesh)
+        return ShardedDeviceResidentData(
+            train_ds, cfg.batch_size, seed=cfg.seed, max_len=cfg.seq_len,
+            mesh=mesh,
+            checksum=getattr(cfg, "sentinel", "none") not in ("none", None))
+    return DeviceResidentData(
+        train_ds, cfg.batch_size, seed=cfg.seed, max_len=cfg.seq_len,
+        mesh=mesh,
+        checksum=getattr(cfg, "sentinel", "none") not in ("none", None))

@@ -15,8 +15,13 @@ module owns the at-rest format:
     zero-copy random row access, so the refill thread's gather is an OS
     page-cache read, not a per-shard decompress;
   * the manifest records n, per-leaf dtype/shape, the shard row table,
-    and per-file byte sizes (the reader cross-checks them, so a
-    truncated shard file fails at open, not as silent garbage mid-epoch).
+    per-file byte sizes (the reader cross-checks them, so a truncated
+    shard file fails at open, not as silent garbage mid-epoch), and a
+    per-file CRC32C (``crc32c`` + ``crc_alg``) — the size check cannot
+    see a same-size byte flip, so the reader re-derives the CRC on
+    first touch of each shard (riding the background window-refill
+    thread) and quarantines-and-continues on mismatch
+    (reader.py / resilience/sentinel.py).
 
 Rows are addressed by GLOBAL sample index; which rows a host reads for
 global batch ``b`` comes from ``loader.pod_epoch_order``'s pure
@@ -43,16 +48,74 @@ MANIFEST = "manifest.json"
 FORMAT = "fdt-stream-v1"
 
 
-def _write_npy_atomic(path: str, arr: np.ndarray) -> int:
+def _checksum_impl():
+    """(algorithm name, whole-buffer fn): CRC32C via google_crc32c when
+    the wheel is present (hardware-accelerated, the GCS/TPU-fleet
+    convention), else zlib's CRC32 — always available, same 32-bit
+    detection strength for random bit-rot.  The manifest records which
+    one signed each file (``crc_alg``), so a reader environment with a
+    different library set verifies with the RIGHT polynomial or skips
+    loudly instead of false-alarming."""
+    try:
+        import google_crc32c
+
+        return "crc32c", lambda b: int(google_crc32c.value(bytes(b)))
+    except Exception:
+        import zlib
+
+        return "crc32", lambda b: zlib.crc32(bytes(b)) & 0xFFFFFFFF
+
+
+CRC_ALG, _crc_bytes = _checksum_impl()
+
+
+def checksum_file(path: str, alg: str = CRC_ALG) -> Optional[int]:
+    """Streaming file checksum under ``alg`` (chunked — shard files can
+    exceed comfortable one-read sizes).  None when ``alg`` isn't
+    computable in this environment (the reader then SKIPS verification
+    for that file rather than inventing a mismatch)."""
+    if alg == "crc32c":
+        try:
+            import google_crc32c
+        except Exception:
+            return None
+        crc = 0
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                crc = google_crc32c.extend(crc, chunk)
+        return int(crc)
+    if alg == "crc32":
+        import zlib
+        crc = 0
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                crc = zlib.crc32(chunk, crc)
+        return crc & 0xFFFFFFFF
+    return None
+
+
+def checksum_bytes(data) -> int:
+    """One-shot checksum of an in-memory buffer under this
+    environment's :data:`CRC_ALG` — the resident-upload integrity tag
+    (data/device_resident.py) shares the shard files' definition."""
+    return _crc_bytes(data)
+
+
+def _write_npy_atomic(path: str, arr: np.ndarray) -> Tuple[int, int]:
     """np.save via tmp + os.replace so a crashed writer never leaves a
-    half-written shard under its final name.  Returns the byte size."""
+    half-written shard under its final name.  Returns (byte size,
+    checksum) — the checksum re-reads what the filesystem actually
+    durably holds (straight from page cache), not the array in memory,
+    so a write-path corruption is signed as-is and caught at first
+    verify instead of laundered into a 'valid' manifest entry."""
     tmp = f"{path}.tmp{os.getpid()}"
     with open(tmp, "wb") as f:
         np.save(f, arr)
         f.flush()
         os.fsync(f.fileno())
+    crc = checksum_file(tmp)
     os.replace(tmp, path)
-    return os.path.getsize(path)
+    return os.path.getsize(path), int(crc or 0)
 
 
 def write_stream_dataset(directory: str,
@@ -87,9 +150,14 @@ def write_stream_dataset(directory: str,
                 arr = np.concatenate(parts) if len(parts) > 1 else parts[0]
                 cut, remainder = arr[:take], arr[take:]
                 fname = f"shard_{idx:05d}.{leaf}.npy"
-                size = _write_npy_atomic(os.path.join(directory, fname),
-                                         np.ascontiguousarray(cut))
-                files[leaf] = {"file": fname, "bytes": size}
+                size, crc = _write_npy_atomic(
+                    os.path.join(directory, fname),
+                    np.ascontiguousarray(cut))
+                # end-to-end integrity: the reader re-derives this on
+                # first touch of the shard (background window refill) —
+                # a byte-flip keeps the size, only the CRC catches it
+                files[leaf] = {"file": fname, "bytes": size,
+                               "crc32c": crc, "crc_alg": CRC_ALG}
                 rest[leaf] = [remainder] if len(remainder) else []
             shards.append({"rows": take, "files": files})
             pending = rest

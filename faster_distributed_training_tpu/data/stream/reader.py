@@ -16,12 +16,13 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List, Sequence
+import warnings
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from faster_distributed_training_tpu.data.stream.format import (FORMAT,
-                                                                MANIFEST)
+from faster_distributed_training_tpu.data.stream.format import (
+    FORMAT, MANIFEST, checksum_file)
 
 
 class ShardedStreamDataset:
@@ -50,6 +51,28 @@ class ShardedStreamDataset:
                              f"!= n {self.n} (torn manifest?)")
         # shard s covers global rows [starts[s], starts[s] + rows[s])
         self._starts = np.concatenate([[0], np.cumsum(rows)[:-1]])
+        self._rows = rows
+        # end-to-end integrity (format v1+ manifests carry per-file
+        # CRCs): expected (path, alg, crc) per shard, verified LAZILY on
+        # the first gather that touches the shard — which in the
+        # streamed data path is the background window-refill thread, so
+        # verification never blocks the dispatch loop.  A failed shard
+        # is quarantined and its rows deterministically remapped to a
+        # healthy shard (same remap on every process: pure function of
+        # the manifest + CRC verdicts) — the run continues, never
+        # crashes.  on_quarantine is the sentinel's wire-in
+        # (cli.run_training -> Sentinel.quarantine_shard).
+        self._crc: Dict[int, List[tuple]] = {}
+        self._crc_checked = [False] * len(shards)
+        self._bad_shards: set = set()
+        self.on_quarantine: Optional[Callable[[int, str], None]] = None
+        for si, s in enumerate(shards):
+            for leaf, info in s["files"].items():
+                if "crc32c" in info:
+                    self._crc.setdefault(si, []).append(
+                        (os.path.join(self.directory, info["file"]),
+                         info.get("crc_alg", "crc32c"),
+                         int(info["crc32c"])))
         self._mmaps: Dict[str, List[np.ndarray]] = {}
         for leaf, spec in self.leaf_spec.items():
             maps = []
@@ -95,6 +118,59 @@ class ShardedStreamDataset:
                          * int(np.prod(spec["shape"] or [1])))
         return total
 
+    def _verify_shard(self, s: int) -> None:
+        """First-touch CRC verification of shard ``s`` (all leaves);
+        a mismatch quarantines the shard (sentinel callback when wired,
+        loud warning regardless) — it never raises."""
+        if self._crc_checked[s]:
+            return
+        self._crc_checked[s] = True
+        for path, alg, want in self._crc.get(s, ()):
+            got = checksum_file(path, alg)
+            if got is None or got == want:
+                # None: alg not computable here (e.g. a crc32c-signed
+                # manifest read where google_crc32c is absent) — cannot
+                # verify, must not false-alarm
+                continue
+            self._bad_shards.add(s)
+            msg = (f"stream shard {s} CRC mismatch ({path}: {alg} "
+                   f"{got:#010x} != manifest {want:#010x}) — shard "
+                   f"quarantined, rows remapped to a healthy shard")
+            warnings.warn(msg, stacklevel=3)
+            if self.on_quarantine is not None:
+                try:
+                    self.on_quarantine(s, path)
+                except Exception:
+                    pass  # integrity reporting must not kill the refill
+            break
+
+    def _screen(self, idx: np.ndarray, shard_of: np.ndarray):
+        """Verify every shard ``idx`` touches; remap rows of
+        quarantined shards onto the first healthy shard (position
+        preserved modulo its row count — deterministic on every
+        process).  Loops because a remap target needs verifying too;
+        bounded by the shard count."""
+        while True:
+            for s in np.unique(shard_of):
+                self._verify_shard(int(s))
+            if not self._bad_shards:
+                return idx, shard_of
+            bad = np.isin(shard_of, sorted(self._bad_shards))
+            if not bad.any():
+                return idx, shard_of
+            good = next((g for g in range(len(self._rows))
+                         if g not in self._bad_shards), None)
+            if good is None:
+                raise RuntimeError(
+                    f"{self.directory}: every stream shard failed its "
+                    f"CRC check — nothing left to serve (restore the "
+                    f"dataset or re-run the shard writer)")
+            off = idx[bad] - self._starts[shard_of[bad]]
+            idx = idx.copy()
+            idx[bad] = self._starts[good] + off % self._rows[good]
+            shard_of = np.searchsorted(self._starts, idx,
+                                       side="right") - 1
+
     def gather(self, indices: Sequence[int]) -> Dict[str, np.ndarray]:
         """Rows at global ``indices`` (any order, repeats allowed) as
         compact host arrays — one vectorized fancy-index per touched
@@ -103,6 +179,8 @@ class ShardedStreamDataset:
         if idx.size and (idx.min() < 0 or idx.max() >= self.n):
             raise IndexError(f"stream gather index out of range [0, {self.n})")
         shard_of = np.searchsorted(self._starts, idx, side="right") - 1
+        if self._crc and idx.size:
+            idx, shard_of = self._screen(idx, shard_of)
         out: Dict[str, np.ndarray] = {}
         for leaf, spec in self.leaf_spec.items():
             dst = np.empty((idx.size,) + tuple(spec["shape"]),

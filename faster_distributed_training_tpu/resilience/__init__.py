@@ -26,7 +26,12 @@ layered on machinery the repo already has:
   * ``coordinator`` — :class:`PodCoordinator` (r10): pod-coordinated
     restarts (shared-fs generation rendezvous so every host restarts
     into the same generation) + the cluster health watchdog (per-host
-    heartbeats, peer-staleness detection, local step-hang escalation).
+    heartbeats, peer-staleness detection, local step-hang escalation);
+  * ``sentinel``    — :class:`Sentinel`: the SILENT-failure ladder —
+    in-graph non-finite bad-step guard (train/steps.py), host-side
+    loss-spike detection with durable batch quarantine +
+    rollback-and-skip replay, and the data-integrity (CRC) verdict
+    sink (``--sentinel guard|full``).
 
 ``Resilience`` bundles the pieces for the Trainer; ``build_resilience``
 constructs the bundle from a TrainConfig (cli.run_training's path).
@@ -61,6 +66,8 @@ from faster_distributed_training_tpu.resilience.storage import (  # noqa: E402,F
     FakeObjectStoreBackend, PosixBackend, StorageBackend, build_backend)
 from faster_distributed_training_tpu.resilience.goodput import (  # noqa: E402,F401,E501
     GoodputTracker)
+from faster_distributed_training_tpu.resilience.sentinel import (  # noqa: E402,F401,E501
+    LossSpike, QuarantineLedger, Sentinel, SpikeDetector, host_finite)
 from faster_distributed_training_tpu.resilience.coordinator import (  # noqa: E402,F401,E501
     PeerFailure, PodCoordinator, SeatTaken, StepTimeout, pod_identity,
     slice_identity, spare_identity)
@@ -100,6 +107,7 @@ class Resilience:
     slice_count: int = 1
     backend: Optional[StorageBackend] = None
     spare_index: Optional[int] = None
+    sentinel: Optional[Sentinel] = None
 
     def adopt_seat(self, seat: int) -> None:
         """r17 warm spares: after the coordinator claimed a failed pod
@@ -140,9 +148,10 @@ def build_resilience(cfg, log: Callable[[str], None] = print
     off (the default — the Trainer's hot loop then has zero new work).
 
     Enabled by any of: --checkpoint_every / --checkpoint_every_secs
-    (step-cadence manager + preemption handler), --supervise, or an
+    (step-cadence manager + preemption handler), --supervise, an
     armed FDT_FAULT_* plan (fault injection needs the hooks even when
-    checkpointing is off).
+    checkpointing is off), or --sentinel guard|full (the anomaly
+    sentinel's counters/ledger live on the bundle).
 
     Pod coordination (r10): with --supervise on a pod (real multi-host,
     or the FDT_POD_INDEX/FDT_POD_COUNT simulation seam) — or whenever
@@ -175,6 +184,7 @@ def build_resilience(cfg, log: Callable[[str], None] = print
     faults = FaultPlan.from_env(process_index=pi)
     cadence = bool(cfg.checkpoint_every or cfg.checkpoint_every_secs)
     step_timeout = float(getattr(cfg, "step_timeout_s", 0.0) or 0.0)
+    sentinel_mode = str(getattr(cfg, "sentinel", "none") or "none")
     if spare is not None and not cfg.supervise:
         log("[resilience] WARNING: FDT_SLICE_SPARE is set but --supervise "
             "is not — the warm-spare park lives on the pod coordinator, "
@@ -187,7 +197,8 @@ def build_resilience(cfg, log: Callable[[str], None] = print
             "--supervise — the hang watchdog lives on the pod coordinator, "
             "which only the supervised path builds; a wedged dispatch "
             "will block forever")
-    if not (cadence or cfg.supervise or faults is not None):
+    if not (cadence or cfg.supervise or faults is not None
+            or sentinel_mode != "none"):
         return None
     # the storage backend every resilience-critical durable write rides
     # (r14): markers, sharded checkpoint phases, retention.  posix =
@@ -277,8 +288,27 @@ def build_resilience(cfg, log: Callable[[str], None] = print
         coordinator.drain_fn = manager.wait
     preemption = PreemptionHandler(sync_every=cfg.preempt_sync_every,
                                    log=log).install()
+    sentinel = None
+    if sentinel_mode != "none":
+        if sentinel_mode == "full" and not (cfg.supervise and cadence):
+            # BEFORE the Sentinel builds, same precedent as the
+            # step_timeout warning above: the spike path still
+            # quarantines durably, but with no supervisor + checkpoint
+            # cadence there is nothing to roll back through in-process
+            log("[resilience] WARNING: --sentinel full without --supervise "
+                "+ --checkpoint_every: a detected loss spike quarantines "
+                "its batches durably but the run then ABORTS instead of "
+                "rolling back in-process (the next start replays with the "
+                "quarantine applied); add --supervise and a checkpoint "
+                "cadence for automatic rollback-and-skip")
+        sentinel = Sentinel(sentinel_mode, backend=backend, goodput=goodput,
+                            window=int(getattr(cfg, "spike_window", 32)),
+                            threshold=float(
+                                getattr(cfg, "spike_threshold", 8.0)),
+                            log=log, root=cfg.checkpoint_dir)
     return Resilience(manager=manager, preemption=preemption,
                       faults=faults, goodput=goodput,
                       coordinator=coordinator, pod_index=pi, pod_count=pc,
                       pod_simulated=simulated, slice_index=si,
-                      slice_count=sc, backend=backend, spare_index=spare)
+                      slice_count=sc, backend=backend, spare_index=spare,
+                      sentinel=sentinel)

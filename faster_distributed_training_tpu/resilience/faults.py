@@ -17,6 +17,25 @@ and fire at exact host-side step/batch counters, never randomly:
     stuck on a dead peer): the r10 pod-scale arm that only the health
     watchdog can clear — nothing raises, nothing exits, the step clock
     just stops (resilience/coordinator.py escalates);
+  * ``FDT_FAULT_NAN_AT_STEP=N``      — poison the loss (and through it
+    every gradient) with NaN at global step N, IN-GRAPH: the multiplier
+    is baked into the jitted program at trace time
+    (:func:`graph_nan_at` -> train/steps.py), so the fault exercises
+    the sentinel's fused non-finite guard exactly where a real
+    overflow/bad-batch NaN appears.  Deliberately NOT once-per-process:
+    the program is pure, so a replay re-poisons step N identically —
+    the guard's skip (which advances ``state.step`` past N) is what
+    moves training forward, which is precisely the contract under test;
+  * ``FDT_FAULT_LOSS_SPIKE_AT_STEP=N`` — multiply the HOST-OBSERVED
+    dispatch loss by 1e4 once at step >= N (the device stream is
+    untouched): exercises the sentinel's median/MAD spike detector,
+    quarantine ledger, and rollback-and-skip replay
+    (resilience/sentinel.py).  Fires once per process like die/hang;
+  * ``FDT_FAULT_CORRUPT_SHARD=S``    — flip bytes inside stream shard S
+    of the train split at startup (size unchanged, so only the CRC32C
+    catches it — the byte-size cross-check at open passes): exercises
+    the data-integrity quarantine (data/stream/reader.py).  Idempotent
+    fixed-pattern overwrite, so restarts re-arm harmlessly;
   * ``FDT_FAULT_HOST=P``             — scope EVERY armed fault above to
     the host with pod process index P (the other hosts of a simulated
     or real pod run fault-free); unset = every process.
@@ -47,6 +66,9 @@ ENV_DIE = "FDT_FAULT_DIE_AT_STEP"
 ENV_SIGTERM = "FDT_FAULT_SIGTERM_AT_STEP"
 ENV_DATA = "FDT_FAULT_DATA_AT_BATCH"
 ENV_HANG = "FDT_FAULT_HANG_AT_STEP"
+ENV_NAN = "FDT_FAULT_NAN_AT_STEP"
+ENV_SPIKE = "FDT_FAULT_LOSS_SPIKE_AT_STEP"
+ENV_CORRUPT = "FDT_FAULT_CORRUPT_SHARD"
 ENV_HOST = "FDT_FAULT_HOST"
 ENV_SLICE = "FDT_FAULT_SLICE"
 
@@ -71,15 +93,22 @@ class FaultPlan:
     def __init__(self, die_at: Optional[int] = None,
                  sigterm_at: Optional[int] = None,
                  data_at: Optional[int] = None,
-                 hang_at: Optional[int] = None):
+                 hang_at: Optional[int] = None,
+                 nan_at: Optional[int] = None,
+                 spike_at: Optional[int] = None,
+                 corrupt_shard: Optional[int] = None):
         self.die_at = die_at
         self.sigterm_at = sigterm_at
         self.data_at = data_at
         self.hang_at = hang_at
+        self.nan_at = nan_at
+        self.spike_at = spike_at
+        self.corrupt_shard = corrupt_shard
         self._die_fired = False
         self._sigterm_fired = False
         self._data_fired = False
         self._hang_fired = False
+        self._spike_fired = False
         # production never sets this — the hang "ends" when the watchdog
         # SIGKILLs the process; in-process tests set it from an injected
         # watchdog abort_fn so the pytest process survives the exercise
@@ -100,7 +129,11 @@ class FaultPlan:
         sig = _env_int(env, ENV_SIGTERM)
         data = _env_int(env, ENV_DATA)
         hang = _env_int(env, ENV_HANG)
-        if die is None and sig is None and data is None and hang is None:
+        nan = _env_int(env, ENV_NAN)
+        spike = _env_int(env, ENV_SPIKE)
+        corrupt = _env_int(env, ENV_CORRUPT)
+        if (die is None and sig is None and data is None and hang is None
+                and nan is None and spike is None and corrupt is None):
             return None
         host = _env_int(env, ENV_HOST)
         slice_ = _env_int(env, ENV_SLICE)
@@ -114,7 +147,8 @@ class FaultPlan:
             if slice_ is not None and slice_identity(
                     env, process_index=process_index)[0] != slice_:
                 return None
-        return cls(die_at=die, sigterm_at=sig, data_at=data, hang_at=hang)
+        return cls(die_at=die, sigterm_at=sig, data_at=data, hang_at=hang,
+                   nan_at=nan, spike_at=spike, corrupt_shard=corrupt)
 
     def on_step(self, step: int) -> None:
         """Called by the train loop after each completed global step."""
@@ -136,6 +170,18 @@ class FaultPlan:
             self._die_fired = True
             raise InjectedFault(f"injected crash at global step {step}")
 
+    def perturb_loss(self, step: int, loss: float) -> float:
+        """The loss-spike arm: scale the HOST-OBSERVED dispatch loss
+        once at step >= spike_at (resilience/sentinel.py feeds its
+        detector through this).  The device metrics stream is never
+        touched — the spike exists only in the sentinel's view, exactly
+        like a bad batch whose gradients are finite but wrong."""
+        if (self.spike_at is not None and step >= self.spike_at
+                and not self._spike_fired):
+            self._spike_fired = True
+            return float(loss) * 1e4
+        return loss
+
     def wrap_data(self, iterable: Iterable) -> Iterator:
         """Data-iterator fault: yields batches until index `data_at`,
         then raises from INSIDE the iterator — through PrefetchIterator /
@@ -150,6 +196,67 @@ class FaultPlan:
                 raise InjectedFault(
                     f"injected data-iterator failure at batch {i}")
             yield item
+
+
+def graph_nan_at(env=os.environ) -> Optional[int]:
+    """The ``FDT_FAULT_NAN_AT_STEP`` arm for train/steps.py: the step
+    at which the jitted program should poison the loss, or None.  Read
+    at TRACE time (the multiplier is baked into the lowered program),
+    honoring the same FDT_FAULT_HOST/FDT_FAULT_SLICE scoping as every
+    other arm."""
+    plan = FaultPlan.from_env(env)
+    return plan.nan_at if plan is not None else None
+
+
+def corrupt_stream_shard(split_dir: str, index: int = 0) -> Optional[str]:
+    """Flip bytes in the middle of stream shard ``index``'s largest
+    leaf file under ``split_dir`` WITHOUT changing its size: the
+    reader's byte-size cross-check at open still passes — only the
+    per-shard CRC32C (data/stream format v1+) catches it, which is the
+    exact silent bit-rot the checksum tier exists for.  Fixed-pattern
+    overwrite (idempotent — a restart re-corrupting the same shard is a
+    no-op).  Returns the damaged path, or None when the split has no
+    manifest yet (nothing to corrupt)."""
+    import json
+    mpath = os.path.join(split_dir, "manifest.json")
+    if not os.path.isfile(mpath):
+        return None
+    with open(mpath) as f:
+        manifest = json.load(f)
+    shards = manifest.get("shards") or []
+    if not 0 <= int(index) < len(shards):
+        raise ValueError(f"{ENV_CORRUPT}={index}: split {split_dir} has "
+                         f"{len(shards)} shard(s)")
+    files = shards[int(index)]["files"]
+    leaf = max(files, key=lambda k: int(files[k]["bytes"]))
+    path = os.path.join(split_dir, files[leaf]["file"])
+    size = os.path.getsize(path)
+    pattern = b"\xde\xad\xbe\xef" * 16
+    # past the .npy header, short of EOF — data bytes, size untouched
+    off = min(max(size // 2, 128), max(size - len(pattern), 0))
+    with open(path, "r+b") as f:
+        f.seek(off)
+        f.write(pattern[:max(size - off, 1)])
+        f.flush()
+        os.fsync(f.fileno())
+    return path
+
+
+def apply_corrupt_shard_fault(stream_dir: str, env=os.environ,
+                              log=print) -> Optional[str]:
+    """Fire the ``FDT_FAULT_CORRUPT_SHARD`` arm (if armed and scoped to
+    this process) against ``<stream_dir>/train`` — called by
+    cli.run_training BEFORE the dataset opens, so the damage is on disk
+    when the reader's background refill first touches the shard.
+    Returns the damaged path or None."""
+    plan = FaultPlan.from_env(env)
+    if plan is None or plan.corrupt_shard is None:
+        return None
+    path = corrupt_stream_shard(os.path.join(stream_dir, "train"),
+                                plan.corrupt_shard)
+    if path is not None:
+        log(f"[faults] corrupted stream shard {plan.corrupt_shard}: {path}")
+    return path
 
 
 def corrupt_newest_checkpoint(directory: str, prefix: str = "ckpt",

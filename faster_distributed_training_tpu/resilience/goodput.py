@@ -34,12 +34,19 @@ _SEGMENTS = ("checkpoint_blocking_s", "emergency_save_s", "restore_s",
 # slice_readmissions / pod_fallback_restarts: r14 slice-granular
 # recovery — completed re-admissions vs holds/rejoins that degraded to
 # the whole-pod protocol; warm_spare_claims / warm_spare_swaps: r17
-# warm-spare slices — seats claimed vs swaps completed through release)
+# warm-spare slices — seats claimed vs swaps completed through release;
+# skipped_steps / rollbacks / quarantined_batches / quarantined_shards:
+# the anomaly sentinel — optimizer updates skipped by the in-graph
+# non-finite guard, loss-spike rollbacks, batch positions durably
+# quarantined by them, and CRC-failed stream shards remapped away
+# (resilience/sentinel.py))
 _COUNTERS = ("saves", "skipped_saves", "save_failures", "shard_writes",
              "restores", "restarts", "preemptions", "steps",
              "peer_failures", "step_timeouts", "restart_generations",
              "slice_readmissions", "pod_fallback_restarts",
-             "warm_spare_claims", "warm_spare_swaps")
+             "warm_spare_claims", "warm_spare_swaps",
+             "skipped_steps", "rollbacks", "quarantined_batches",
+             "quarantined_shards")
 
 
 class GoodputTracker:

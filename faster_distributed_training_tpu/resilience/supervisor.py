@@ -57,6 +57,7 @@ from typing import Any, Callable, Optional, Tuple
 from faster_distributed_training_tpu.resilience import Preempted
 from faster_distributed_training_tpu.resilience.coordinator import (
     PeerFailure, SeatTaken)
+from faster_distributed_training_tpu.resilience.sentinel import LossSpike
 
 
 class Supervisor:
@@ -131,7 +132,12 @@ class Supervisor:
                 # here would make a survivor give up on a flapping peer
                 # with retry budget remaining, breaking the "the pod
                 # exhausts every host's budget together" contract.
-                transient_peer = isinstance(e, PeerFailure)
+                # LossSpike is exempt for the inverse reason: the spike
+                # QUARANTINED its batches before raising, so the replay
+                # is a DIFFERENT program of work — a second spike at the
+                # same step is a new batch spiking, not evidence that
+                # retrying is futile (resilience/sentinel.py).
+                transient_peer = isinstance(e, (PeerFailure, LossSpike))
                 if not transient_peer and last_fail == (step, type(e)):
                     self._log(
                         f"[supervisor] step {step} failed twice in a row "

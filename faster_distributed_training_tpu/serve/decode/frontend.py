@@ -423,10 +423,22 @@ class GenScheduler(BatchScheduler):
 
     def __init__(self, queue: RequestQueue, replicas: ReplicaSet,
                  max_delay_ms: float = 20.0, recorder=None,
+                 request_deadline_s: Optional[float] = None,
                  log: Callable[[str], None] = print):
         super().__init__(queue, replicas, batch_size=1,
                          max_delay_ms=max_delay_ms, recorder=recorder,
+                         request_deadline_s=request_deadline_s,
                          log=log)
+
+    def summary(self) -> dict:
+        """The front door's robustness counters under their README
+        names: a generation re-dispatched because its worker PROCESS
+        died/errored mid-request is a decode_request_retry; one that
+        blew its per-request deadline is a decode_request_timeout."""
+        out = super().summary()
+        out["decode_request_retries"] = out.pop("request_retries", 0)
+        out["decode_request_timeouts"] = out.pop("request_timeouts", 0)
+        return out
 
     def _assemble(self, bucket: int, requests):
         req = requests[0]
@@ -522,9 +534,12 @@ class FrontDoor:
         self.rset = ReplicaSet(self.replicas,
                                heartbeat_timeout_s=heartbeat_timeout_s,
                                readmit_after_s=readmit_after_s, log=log)
-        self.sched = GenScheduler(self.queue, self.rset,
-                                  max_delay_ms=cfg.serve_max_delay_ms,
-                                  recorder=recorder, log=log)
+        self.sched = GenScheduler(
+            self.queue, self.rset,
+            max_delay_ms=cfg.serve_max_delay_ms, recorder=recorder,
+            request_deadline_s=float(
+                getattr(cfg, "decode_deadline_s", 0.0) or 0.0) or None,
+            log=log)
 
     def start(self) -> None:
         # spawn every process first so their warmups overlap, then let

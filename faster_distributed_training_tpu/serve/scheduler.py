@@ -54,6 +54,9 @@ class _Work:
         self.t_created = time.monotonic()
         self.attempts = 0
         self.max_attempts = int(max_attempts)
+        # retry backoff gate: a rescued batch parks until this clock
+        # (monotonic) instead of hammering a replica set mid-respawn
+        self.not_before = 0.0
         self.last_error: Optional[BaseException] = None
         self._on_done = on_done
         self._claim_lock = threading.Lock()
@@ -76,10 +79,15 @@ class _Work:
     def note_failure(self, exc: BaseException) -> None:
         self.last_error = exc
 
-    def fail_all(self, exc: BaseException) -> None:
-        if self.claim():
-            for req in self.requests:
-                req.fail(exc)
+    def fail_all(self, exc: BaseException) -> bool:
+        """Fail every request (first claimer only); True when this call
+        won the claim — failure counters key off that so a rescue/expiry
+        race can never double-count."""
+        if not self.claim():
+            return False
+        for req in self.requests:
+            req.fail(exc)
+        return True
 
 
 class BatchScheduler:
@@ -91,6 +99,9 @@ class BatchScheduler:
     def __init__(self, queue: RequestQueue, replicas: ReplicaSet,
                  batch_size: int, max_delay_ms: float = 20.0,
                  recorder=None, request_events: bool = True,
+                 request_deadline_s: Optional[float] = None,
+                 retry_backoff_s: float = 0.05,
+                 retry_backoff_cap_s: float = 2.0,
                  log: Callable[[str], None] = print):
         self.queue = queue
         self.replicas = replicas
@@ -98,6 +109,19 @@ class BatchScheduler:
         self.max_delay_s = float(max_delay_ms) / 1e3
         self.recorder = recorder
         self.request_events = bool(request_events)
+        # per-request deadline (None = wait forever, the pre-r24
+        # behavior): work whose oldest request has been in the system
+        # longer than this fails with TimeoutError at its next dispatch
+        # or parked-retry tick — a dead/respawning engine process makes
+        # callers wait a BOUNDED time, never forever
+        self.request_deadline_s = (None if request_deadline_s is None
+                                   or request_deadline_s <= 0
+                                   else float(request_deadline_s))
+        # rescue backoff: attempt k re-enters dispatch after
+        # backoff·2^(k-1) (capped) parked seconds, so a batch bounced
+        # off a replica set mid-respawn gives the respawn room to warm
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.retry_backoff_cap_s = float(retry_backoff_cap_s)
         self._log = log
         self._lock = threading.Lock()
         self._parked: List[_Work] = []   # work with no live replica yet
@@ -108,6 +132,8 @@ class BatchScheduler:
         self.completed_requests = 0
         self.completed_batches = 0
         self.padded_rows = 0
+        self.request_retries = 0     # re-dispatches after replica loss
+        self.request_timeouts = 0    # requests failed by the deadline
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
 
@@ -162,7 +188,29 @@ class BatchScheduler:
         attempt budget, replica rescue) is shared."""
         return pad_batch(requests, bucket, self.batch_size)
 
+    def _expire(self, work: _Work) -> bool:
+        """Deadline check: True when the work was failed for age.  The
+        clock starts at batch assembly (``t_created``) — queue wait
+        before assembly is bounded separately by ``max_delay_ms``."""
+        if self.request_deadline_s is None:
+            return False
+        age = time.monotonic() - work.t_created
+        if age <= self.request_deadline_s:
+            return False
+        err: BaseException = TimeoutError(
+            f"request deadline exceeded ({age:.1f}s > "
+            f"{self.request_deadline_s:.1f}s, {work.attempts} dispatch "
+            f"attempt(s), last error: {work.last_error!r})")
+        if work.fail_all(err):
+            with self._lock:
+                self.request_timeouts += work.n_real
+            self._log(f"[serve] batch (bucket {work.bucket}, "
+                      f"{work.n_real} requests) TIMED OUT: {err}")
+        return True
+
     def _dispatch(self, work: _Work) -> None:
+        if self._expire(work):
+            return
         work.attempts += 1
         if work.attempts > work.max_attempts:
             err = work.last_error or RuntimeError(
@@ -178,16 +226,42 @@ class BatchScheduler:
 
     def _redispatch(self, work: _Work) -> None:
         """Requeue sink for the replica set: rescued / failed work
-        re-enters dispatch (unless something already completed it)."""
+        re-enters dispatch (unless something already completed it).
+        A RETRY (attempt >= 1, i.e. an engine died or errored
+        mid-request) is counted and parks through the bounded
+        exponential backoff instead of re-entering immediately."""
         if work.claimed:
             return
+        if work.attempts >= 1:
+            with self._lock:
+                self.request_retries += 1
+            if self.retry_backoff_s > 0:
+                work.not_before = time.monotonic() + min(
+                    self.retry_backoff_s * 2.0 ** (work.attempts - 1),
+                    self.retry_backoff_cap_s)
+                with self._lock:
+                    self._parked.append(work)
+                return
         self._dispatch(work)
 
     def _retry_parked(self) -> None:
+        now = time.monotonic()
         with self._lock:
             parked, self._parked = self._parked, []
         for work in parked:
             if work.claimed:
+                continue
+            if self._expire(work):
+                continue
+            if work.not_before > now:
+                with self._lock:
+                    self._parked.append(work)
+                continue
+            if work.not_before:
+                # backoff elapsed: this re-entry is a true dispatch
+                # attempt (budget-counted), not a no-replica park loop
+                work.not_before = 0.0
+                self._dispatch(work)
                 continue
             if not self.replicas.dispatch(work):
                 with self._lock:
@@ -247,7 +321,9 @@ class BatchScheduler:
                         and self._t_last is not None
                         and self._t_last > self._t_first) else 0.0)
             out = {"requests": n, "batches": self.completed_batches,
-                   "padded_rows": self.padded_rows}
+                   "padded_rows": self.padded_rows,
+                   "request_retries": self.request_retries,
+                   "request_timeouts": self.request_timeouts}
         pct = percentiles(lats, qs=(50, 99))
         out["p50_ms"] = pct.get(50, 0.0)
         out["p99_ms"] = pct.get(99, 0.0)

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import contextlib
 import itertools
-import math
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
@@ -24,6 +23,12 @@ import numpy as np
 
 from faster_distributed_training_tpu.config import TrainConfig
 from faster_distributed_training_tpu.data.loader import device_prefetch
+# host_finite is THE repo-wide host-side finiteness definition (one
+# non-finite vocabulary shared with the in-graph sentinel guard); it
+# deliberately operates on ALREADY-FETCHED Python floats — using
+# jax.numpy.isfinite here would accept a still-on-device scalar and add
+# a blocking round-trip at the epoch boundary
+from faster_distributed_training_tpu.resilience.sentinel import host_finite
 from faster_distributed_training_tpu.telemetry import spans
 from faster_distributed_training_tpu.train import checkpoint as ckpt
 from faster_distributed_training_tpu.train.metrics import (MetricAccumulator,
@@ -35,17 +40,6 @@ from faster_distributed_training_tpu.utils.profiling import (
     memory_watermarks, peak_memory_bytes)
 
 LoaderFn = Callable[[int], Iterable[Dict[str, Any]]]
-
-
-def _finite(x) -> bool:
-    """Host-side finiteness check on an ALREADY-FETCHED epoch metric
-    (MetricAccumulator.summary() returns Python floats).  Deliberately
-    not jax.numpy.isfinite: that would accept a still-on-device scalar
-    and add a blocking device round-trip at the epoch boundary."""
-    try:
-        return x is not None and math.isfinite(float(x))
-    except (TypeError, ValueError):
-        return False
 
 
 def _stack_host_batches(group: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -408,6 +402,12 @@ class Trainer:
         t0 = time.monotonic()
         metrics = None
         res = self.resilience
+        # anomaly sentinel (resilience/sentinel.py): quarantined batch
+        # positions — pure (epoch, position) set agreed across hosts via
+        # the durable ledger — are consumed-and-skipped below, so a
+        # post-rollback replay deterministically excludes the batches a
+        # loss spike indicted.  None = zero hot-path overhead.
+        sent = getattr(res, "sentinel", None) if res is not None else None
         # keep a handle to the prefetch thread's cancel path BEFORE any
         # wrapping: an abnormal loop exit (preemption, injected fault)
         # must not strand the worker blocked on a full queue
@@ -454,6 +454,14 @@ class Trainer:
                     batch = next(it)
                 except StopIteration:
                     break
+                if sent is not None and sent.quarantined(epoch, n):
+                    # consume-and-skip: the batch is materialized (the
+                    # loader API yields, it doesn't seek) but never
+                    # dispatched — params/opt-state/step untouched, so
+                    # the replayed epoch is bitwise the epoch that never
+                    # saw this batch
+                    n += 1
+                    continue
                 t_disp = time.monotonic() if want else 0.0
                 self._prof_before(1)
                 state, metrics = self.train_step(state, batch)
@@ -465,7 +473,9 @@ class Trainer:
                     self._observe_state_placement(state)
                 self._prof_after(metrics)
                 if res is not None:
-                    state = self._resilience_hooks(state, epoch, n)
+                    state = self._resilience_hooks(state, epoch, n,
+                                                   metrics=metrics,
+                                                   group=(n - 1, 1))
                 t_end = time.monotonic()
                 self._blocked_since_log += t_end - t_done
                 self._record_dispatch(
@@ -540,6 +550,7 @@ class Trainer:
         t0 = time.monotonic()
         metrics = None
         res = self.resilience
+        sent = getattr(res, "sentinel", None) if res is not None else None
         closer = getattr(loader, "close", None)
         if res is not None and res.faults is not None:
             loader = res.faults.wrap_data(loader)
@@ -565,6 +576,18 @@ class Trainer:
                 group = list(itertools.islice(it, self.k))
                 if not group:
                     break
+                kk_full = len(group)
+                if sent is not None:
+                    # quarantined positions drop out of the stacked group
+                    # (the order cursor still advances by the FULL group,
+                    # so the surviving batches are the identical content
+                    # at their identical positions); a shorter group
+                    # compiles its own kk program like any epoch tail
+                    group = [b for j, b in enumerate(group)
+                             if not sent.quarantined(epoch, n + j)]
+                    if not group:
+                        n += kk_full
+                        continue
                 kk = len(group)
                 want = self._keep_dispatch_times(("host", kk))
                 batch = self.put_stacked(_stack_host_batches(group))
@@ -573,14 +596,15 @@ class Trainer:
                 state, metrics = self._fused_step(kk)(state, batch)
                 t_done = time.monotonic()
                 acc.add(metrics)
-                n += kk
+                n += kk_full
                 self.global_step += kk
                 if self._sharding_expect is None:
                     self._observe_state_placement(state)
                 self._prof_after(metrics)
                 if res is not None:
-                    state = self._resilience_hooks(state, epoch, n,
-                                                   n_steps=kk)
+                    state = self._resilience_hooks(
+                        state, epoch, n, n_steps=kk, metrics=metrics,
+                        group=(n - kk_full, kk_full))
                 t_end = time.monotonic()
                 self._blocked_since_log += t_end - t_done
                 self._record_dispatch(
@@ -615,6 +639,7 @@ class Trainer:
         t0 = time.monotonic()
         metrics = None
         res = self.resilience
+        sent = getattr(res, "sentinel", None) if res is not None else None
         # sharded residency re-shards into this epoch's batch-major view
         # here (ONE collective per epoch); the replicated layout returns
         # its static arrays and the order drives the in-graph gather
@@ -633,29 +658,51 @@ class Trainer:
         self._blocked_since_log = 0.0
         while n < n_steps:
             kk = min(self.k, n_steps - n)
-            want = self._keep_dispatch_times(("resident", kk))
+            # quarantine-aware dispatch plan: the common case is the
+            # single full segment [(n, kk)] (sent.plan's fast path);
+            # after a spike rollback the window splits around the
+            # quarantined positions — one fused dispatch per surviving
+            # contiguous run, each seeking its own in-graph start, so
+            # the epoch-order cursor algebra stays pure
+            segs = (sent.plan(epoch, n, kk) if sent is not None
+                    else [(n, kk)])
+            if not segs:
+                n += kk
+                continue
+            run = sum(l for _, l in segs)
+            key = ("resident", segs[-1][1])
+            want = self._keep_dispatch_times(key)
             t_rec = time.monotonic() if want else 0.0
-            self._prof_before(kk)
-            state, metrics = self._fused_step(kk, resident)(
+            for s, l in segs[:-1]:
+                self._prof_before(l)
+                state, m = self._fused_step(l, resident)(
+                    state, data, order,
+                    jax.numpy.asarray(s, jax.numpy.int32))
+                acc.add(m)
+            s0, l0 = segs[-1]
+            self._prof_before(l0)
+            state, metrics = self._fused_step(l0, resident)(
                 state, data, order,
-                jax.numpy.asarray(n, jax.numpy.int32))
+                jax.numpy.asarray(s0, jax.numpy.int32))
             t_done = time.monotonic()
             acc.add(metrics)
             n += kk
-            self.global_step += kk
+            self.global_step += run
             if self._sharding_expect is None:
                 self._observe_state_placement(state)
             self._prof_after(metrics)
             if res is not None:
                 state = self._resilience_hooks(state, epoch, n,
-                                               n_steps=kk)
+                                               n_steps=run,
+                                               metrics=metrics,
+                                               group=(n - kk, kk))
             t_end = time.monotonic()
             self._blocked_since_log += t_end - t_done
             self._record_dispatch(
-                epoch, n, kk, t_end - t_rec if want else 0.0,
+                epoch, n, run, t_end - t_rec if want else 0.0,
                 t_done - t_rec if want else 0.0, 0.0, t_end - t_done,
-                ("resident", kk))
-            last = self._log_dispatch(epoch, n, kk, metrics, last)
+                key)
+            last = self._log_dispatch(epoch, n, run, metrics, last)
         if metrics is not None:
             float(metrics["loss"])     # fence (see run_epoch)
         self._last_epoch_steps = n
@@ -691,6 +738,7 @@ class Trainer:
         t0 = time.monotonic()
         metrics = None
         res = self.resilience
+        sent = getattr(res, "sentinel", None) if res is not None else None
         n_steps = src.steps_per_epoch
         if start_step:
             self.log(f"[resume] epoch {epoch}: stream seek to batch "
@@ -711,24 +759,42 @@ class Trainer:
                 base, hi, data = window.buffer_for(n)
                 t_disp = time.monotonic()
                 kk = min(self.k, n_steps - n, hi - n)
-                key = ("stream", kk)
+                # quarantine-aware plan (see _run_epoch_resident): the
+                # in-graph start is buffer-relative, so each segment
+                # dispatches at ``s - base``
+                segs = (sent.plan(epoch, n, kk) if sent is not None
+                        else [(n, kk)])
+                if not segs:
+                    n += kk
+                    continue
+                run = sum(l for _, l in segs)
+                key = ("stream", segs[-1][1])
                 first = key not in self._dispatched
                 want = first or self._keep_dispatch_times(key)
-                self._prof_before(kk)
-                state, metrics = self._fused_step(kk, src)(
+                for s, l in segs[:-1]:
+                    self._prof_before(l)
+                    state, m = self._fused_step(l, src)(
+                        state, data, src.dummy_order,
+                        jax.numpy.asarray(s - base, jax.numpy.int32))
+                    acc.add(m)
+                s0, l0 = segs[-1]
+                self._prof_before(l0)
+                state, metrics = self._fused_step(l0, src)(
                     state, data, src.dummy_order,
-                    jax.numpy.asarray(n - base, jax.numpy.int32))
+                    jax.numpy.asarray(s0 - base, jax.numpy.int32))
                 t_done = time.monotonic()
                 acc.add(metrics)
                 n += kk
-                self.global_step += kk
+                self.global_step += run
                 if self._sharding_expect is None:
                     self._observe_state_placement(state)
                 self._prof_after(metrics)
                 t_step = time.monotonic()
                 if res is not None:
                     state = self._resilience_hooks(state, epoch, n,
-                                                   n_steps=kk)
+                                                   n_steps=run,
+                                                   metrics=metrics,
+                                                   group=(n - kk, kk))
                 t_end = time.monotonic()
                 self._blocked_since_log += t_end - t_done
                 if not first and not epoch_cold:
@@ -743,11 +809,11 @@ class Trainer:
                     self._stream_wall_s += t_step - t_rec
                 epoch_cold = False
                 self._record_dispatch(
-                    epoch, n, kk, t_end - t_rec if want else 0.0,
+                    epoch, n, run, t_end - t_rec if want else 0.0,
                     t_done - t_disp if want else 0.0,
                     t_disp - t_rec if want else 0.0,
                     t_end - t_done, key)
-                last = self._log_dispatch(epoch, n, kk, metrics, last)
+                last = self._log_dispatch(epoch, n, run, metrics, last)
         finally:
             # normal AND abnormal exits reclaim the refill thread (the
             # prefetch-closer contract the host paths honor in except:)
@@ -767,20 +833,41 @@ class Trainer:
         return 100.0 * self._stream_stall_s / self._stream_wall_s
 
     def _resilience_hooks(self, state: TrainState, epoch: int,
-                          step_in_epoch: int, n_steps: int = 1
-                          ) -> TrainState:
+                          step_in_epoch: int, n_steps: int = 1,
+                          metrics=None, group=None) -> TrainState:
         """Per-dispatch resilience work, in hazard order: injected
         faults first (a crash preempts bookkeeping, like the real
-        thing), then the cross-host-agreed preemption decision
-        (emergency save + clean Preempted exit), then cadence
-        checkpointing.  `n_steps` = train steps this dispatch advanced
-        (K under the fused dispatch) so the goodput step counter stays
-        per-STEP while the polling stays per-dispatch."""
+        thing), then the sentinel's loss-spike observation, then the
+        cross-host-agreed preemption decision (emergency save + clean
+        Preempted exit), then cadence checkpointing.  `n_steps` = train
+        steps this dispatch advanced (K under the fused dispatch) so
+        the goodput step counter stays per-STEP while the polling stays
+        per-dispatch.  `metrics`/`group` feed the full-mode sentinel:
+        the dispatch's metrics dict and the (start, count) epoch-order
+        window it covered — quarantined positions inside the window
+        were NOT dispatched; Sentinel.observe re-filters them."""
         res = self.resilience
         step = self.global_step
         res.goodput.count("steps", n_steps)
         if res.faults is not None:
             res.faults.on_step(step)    # may SIGTERM this process / raise
+        sent = getattr(res, "sentinel", None)
+        if (sent is not None and sent.mode == "full" and metrics is not None
+                and group is not None):
+            # the ONE per-dispatch device sync --sentinel full buys
+            # (bench's sentinel_overhead_pct): the dispatch loss is a
+            # replicated global scalar, so every host reads the same
+            # value, reaches the same spike verdict, and writes the
+            # same quarantine ledger — no cross-host protocol needed.
+            # Runs BEFORE the checkpoint hooks so the newest checkpoint
+            # always predates the quarantined dispatch and the
+            # rollback-replay actually excises it.  May raise LossSpike
+            # (restartable; the supervisor replays from the newest
+            # valid checkpoint with the indicted batches quarantined).
+            loss = float(jax.device_get(metrics["loss"]))
+            if res.faults is not None:
+                loss = res.faults.perturb_loss(step, loss)
+            sent.observe(epoch, group[0], group[1], loss, step)
         if res.coordinator is not None:
             # pod health: feed the step clock to the local watchdog and
             # (cadence-gated) poll the peers' FAIL/heartbeat markers —
@@ -957,6 +1044,16 @@ class Trainer:
                     else train_loader(epoch),
                     epoch, start_step=resume_step)
             resumed_mid_epoch, resume_step = resume_step, 0
+            if res is not None:
+                # in-graph bad-step guard accounting: bad_steps was
+                # summed on device across the epoch's dispatches and
+                # rode the normal metrics fetch — counting it here costs
+                # no extra sync (r24: the guard's verdict is read
+                # where the epoch summary is already host-side)
+                bad = train_m.get("bad_steps_sum")
+                if bad:
+                    res.goodput.count("skipped_steps",
+                                      int(round(float(bad))))
             # Failure detection (a deliberate addition — the reference's
             # only recovery is manual re-launch with --resume, SURVEY.md
             # §5): a non-finite epoch loss means the run is poisoned; roll
@@ -979,7 +1076,7 @@ class Trainer:
                         f"small for batch_size={cfg.batch_size} x "
                         f"{jax.process_count()} process(es)?")
             if ("loss" in train_m and cfg.auto_recover
-                    and not _finite(train_m.get("loss"))):
+                    and not host_finite(train_m.get("loss"))):
                 consecutive_failures += 1
                 if consecutive_failures > cfg.max_recoveries:
                     raise RuntimeError(
@@ -1037,9 +1134,9 @@ class Trainer:
                 # epoch loss (train/metrics.perplexity), train and eval
                 from faster_distributed_training_tpu.train.metrics import (
                     perplexity)
-                if _finite(train_m.get("loss")):
+                if host_finite(train_m.get("loss")):
                     train_m["perplexity"] = perplexity(train_m["loss"])
-                if _finite(test_m.get("loss")):
+                if host_finite(test_m.get("loss")):
                     test_m["perplexity"] = perplexity(test_m["loss"])
                 self.history["train_ppl"].append(
                     train_m.get("perplexity", 0.0))

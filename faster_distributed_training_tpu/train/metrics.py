@@ -121,7 +121,10 @@ def format_goodput(tracker) -> str:
                                  "restarts", "preemptions", "peer_failures",
                                  "step_timeouts", "restart_generations",
                                  "slice_readmissions",
-                                 "pod_fallback_restarts")
+                                 "pod_fallback_restarts",
+                                 "skipped_steps", "rollbacks",
+                                 "quarantined_batches",
+                                 "quarantined_shards")
                        if s.get(k))
     if counts:
         bits.append(counts)

@@ -45,6 +45,42 @@ def _tree_where(pred, a, b):
     return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
 
 
+def _sentinel_ok(loss, grads, finite) -> jax.Array:
+    """The sentinel's fused bad-step verdict: ONE bit over the
+    unscaled per-step loss, the global grad norm, and the loss-scale
+    overflow check it rides on (amp.unscale_and_check — already True
+    outside fp16).  Both operands are global scalars inside the jitted
+    program (the loss is psum-reduced by GSPMD, the norm spans the
+    whole grad tree), so the bit is identical on every (dp, tp, pp)
+    host BY CONSTRUCTION — no host round-trip, no agreement protocol.
+    An fp32-overflowing grad norm reports inf -> not finite, which is
+    the right verdict for a gradient that large."""
+    import optax
+    gnorm = optax.global_norm(grads)
+    return (jnp.isfinite(loss) & jnp.isfinite(gnorm)
+            & jnp.asarray(finite, bool))
+
+
+def _sentinel_metrics(metrics: Metrics, ok: jax.Array) -> Metrics:
+    """Mask a guarded step's contribution out of the epoch sums via
+    ``where`` (NOT multiplication: 0 * NaN is NaN, and the whole point
+    is that the bad step's loss may be NaN).  ``loss_total`` is
+    materialized first so the accumulator's exact-weighted epoch loss
+    (loss_total/total) spans only the steps that actually updated;
+    gauges (loss_scale) pass through unmasked.  ``bad_steps`` is the
+    counted verdict — summed by the scan reduction and the epoch
+    accumulator into ``bad_steps_sum``, which the Trainer forwards to
+    the ``skipped_steps`` goodput counter with NO extra device sync
+    (it rides the one summary fetch per epoch)."""
+    out = dict(metrics)
+    if "loss_total" not in out:
+        out["loss_total"] = out["loss"] * out["total"]
+    for kk in ("loss", "loss_total", "correct", "total"):
+        out[kk] = jnp.where(ok, out[kk], jnp.zeros_like(out[kk]))
+    out["bad_steps"] = 1.0 - ok.astype(jnp.float32)
+    return out
+
+
 def lm_shift_metrics(logits: jax.Array, tokens: jax.Array,
                      tok_mask: Optional[jax.Array] = None,
                      sample_valid: Optional[jax.Array] = None
@@ -132,6 +168,18 @@ def make_train_step(cfg: TrainConfig, state_shardings=None, pipeline=None
     (models/transformer.py).  None (every pp=1 config) adds NOTHING to
     the apply call, so those programs stay byte-identical to r21."""
     fp16 = cfg.precision == "fp16"
+    # --sentinel guard|full: arm the in-graph bad-step guard.  A
+    # TRACE-time Python flag, so --sentinel none programs stay
+    # byte-identical to the unguarded build (pinned by
+    # tests/test_sentinel.py); when armed, the fp16 GradScaler skip
+    # below generalizes to every precision with the fused verdict.
+    sentinel_on = getattr(cfg, "sentinel", "none") not in ("none", None)
+    # FDT_FAULT_NAN_AT_STEP: the poison multiplier is baked into the
+    # program at trace time (lazy import — faults.py pulls in the
+    # resilience package, which train.steps must not need at import)
+    from faster_distributed_training_tpu.resilience.faults import (
+        graph_nan_at)
+    nan_at = graph_nan_at()
     is_text = cfg.model == "transformer"
     lm = getattr(cfg, "task", "cls") == "lm"
     if lm and not is_text:
@@ -247,6 +295,12 @@ def make_train_step(cfg: TrainConfig, state_shardings=None, pipeline=None
                     logits, batch["tokens"], batch.get("mask"))
                 loss = loss_total / jnp.maximum(total, 1.0)
                 scaled = scale_loss(loss, state.loss_scale, fp16)
+                if nan_at is not None:
+                    # multiplicative poison: the NaN flows through the
+                    # backward pass, so every gradient leaf is NaN too —
+                    # exactly the shape of a real overflow/bad batch
+                    scaled = scaled * jnp.where(state.step == nan_at,
+                                                jnp.nan, 1.0)
                 new_stats = mutated.get("batch_stats", state.batch_stats)
                 return scaled, (loss, loss_total, correct, total, new_stats)
 
@@ -254,16 +308,20 @@ def make_train_step(cfg: TrainConfig, state_shardings=None, pipeline=None
                 loss_fn, has_aux=True)(state.params)
             grads = reduce_grads(grads)
             grads, finite = unscale_and_check(grads, state.loss_scale, fp16)
+            ok = _sentinel_ok(loss, grads, finite) if sentinel_on \
+                else finite
             updated = state.apply_gradients(grads).replace(
                 batch_stats=new_stats,
                 loss_scale=update_loss_scale(state.loss_scale, finite,
                                              fp16))
-            if fp16:
+            if fp16 or sentinel_on:
+                # the loss-scale ladder keys off the overflow bit
+                # (finite), the sentinel skip off the fused verdict (ok)
                 skipped = state.replace(
                     step=state.step + 1,
                     loss_scale=update_loss_scale(state.loss_scale, finite,
                                                  fp16))
-                updated = _tree_where(finite, updated, skipped)
+                updated = _tree_where(ok, updated, skipped)
             # loss = per-TOKEN mean (perplexity's log); total counts
             # target tokens, so the accumulator's loss_total/total is
             # the exact token-weighted epoch loss and "accuracy" is
@@ -273,6 +331,8 @@ def make_train_step(cfg: TrainConfig, state_shardings=None, pipeline=None
                        "correct": correct, "total": total}
             if fp16:
                 metrics["loss_scale"] = updated.loss_scale.scale
+            if sentinel_on:
+                metrics = _sentinel_metrics(metrics, ok)
             if constrain_out:
                 updated = jax.tree.map(jax.lax.with_sharding_constraint,
                                        updated, state_shardings)
@@ -320,6 +380,9 @@ def make_train_step(cfg: TrainConfig, state_shardings=None, pipeline=None
                     loss = mx.mixup_criterion(cross_entropy, logits, y_a,
                                               y_b, lam)
             scaled = scale_loss(loss, state.loss_scale, fp16)
+            if nan_at is not None:
+                scaled = scaled * jnp.where(state.step == nan_at,
+                                            jnp.nan, 1.0)
             new_stats = mutated.get("batch_stats", state.batch_stats)
             return scaled, (loss, logits, y_a, y_b, lam, new_stats)
 
@@ -327,17 +390,18 @@ def make_train_step(cfg: TrainConfig, state_shardings=None, pipeline=None
             loss_fn, has_aux=True)(state.params)
         grads = reduce_grads(grads)
         grads, finite = unscale_and_check(grads, state.loss_scale, fp16)
+        ok = _sentinel_ok(loss, grads, finite) if sentinel_on else finite
 
         updated = state.apply_gradients(grads).replace(
             batch_stats=new_stats,
             loss_scale=update_loss_scale(state.loss_scale, finite, fp16))
-        if fp16:
+        if fp16 or sentinel_on:
             # skip the whole update on non-finite grads (GradScaler policy,
             # resnet50_test.py:547-548) — but still advance step & scale
             skipped = state.replace(
                 step=state.step + 1,
                 loss_scale=update_loss_scale(state.loss_scale, finite, fp16))
-            updated = _tree_where(finite, updated, skipped)
+            updated = _tree_where(ok, updated, skipped)
 
         # mixup-weighted train accuracy (resnet50_test.py:550-558)
         pred = jnp.argmax(logits, axis=-1)
@@ -352,6 +416,8 @@ def make_train_step(cfg: TrainConfig, state_shardings=None, pipeline=None
                    "total": jnp.asarray(y.shape[0], jnp.float32)}
         if fp16:
             metrics["loss_scale"] = updated.loss_scale.scale
+        if sentinel_on:
+            metrics = _sentinel_metrics(metrics, ok)
         if constrain_out:
             updated = jax.tree.map(jax.lax.with_sharding_constraint,
                                    updated, state_shardings)
@@ -377,6 +443,11 @@ def _reduce_scanned_metrics(ms: Metrics) -> Metrics:
                           else jnp.sum(ms["loss"] * ms["total"])),
            "correct": jnp.sum(ms["correct"]),
            "total": jnp.sum(ms["total"])}
+    if "bad_steps" in ms:
+        # the sentinel's counted verdicts (one 0/1 per scanned step) —
+        # summed here and again by the epoch accumulator into
+        # bad_steps_sum, the Trainer's skipped_steps feed
+        out["bad_steps"] = jnp.sum(ms["bad_steps"])
     if "loss_scale" in ms:
         out["loss_scale"] = jax.tree.map(lambda x: x[-1], ms["loss_scale"])
     return out

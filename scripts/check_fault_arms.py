@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Fault-arm drift lint (r24 satellite).
+
+The chaos-injection surface (``FDT_FAULT_*`` env arms,
+resilience/faults.py) is only trustworthy if every arm is (a) parsed —
+an arm the plan parser ignores silently injects NOTHING, and a chaos
+test "passes" by testing the happy path — and (b) documented — an
+undocumented arm rots into folklore.  This lint makes both drifts a
+tier-1 failure (tests/test_fault_arms.py):
+
+  1. every ``FDT_FAULT_*`` name referenced anywhere in package or
+     scripts source must appear in README.md's fault-injection table
+     (a ``| `FDT_FAULT_...` | ... |`` row);
+  2. every such name must be bound to a module-level ``ENV_*`` constant
+     in resilience/faults.py whose identifier appears in the source of
+     ``FaultPlan.from_env`` — i.e. the parser actually reads it;
+  3. the README table must not document arms no source references
+     (stale rows rot the table itself).
+
+Run:  python scripts/check_fault_arms.py   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import re
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+PKG = os.path.join(_REPO, "faster_distributed_training_tpu")
+README = os.path.join(_REPO, "README.md")
+
+_ARM = re.compile(r"FDT_FAULT_[A-Z0-9_]+")
+
+
+def source_arm_names() -> set:
+    """Every FDT_FAULT_* name referenced in package + scripts source
+    (docstrings count: a documented-in-code arm is a referenced arm).
+    This lint file itself is excluded."""
+    names: set = set()
+    roots = [PKG, _HERE]
+    for root in roots:
+        for dirpath, _dirs, files in os.walk(root):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                if os.path.abspath(path) == os.path.abspath(__file__):
+                    continue
+                with open(path, errors="replace") as fh:
+                    names.update(_ARM.findall(fh.read()))
+    return names
+
+
+def readme_arm_rows(path: str = README) -> set:
+    """Arm names documented as fault-table rows (``| `FDT_FAULT_...``)."""
+    rows: set = set()
+    with open(path, errors="replace") as fh:
+        for line in fh:
+            if line.lstrip().startswith("|"):
+                rows.update(_ARM.findall(line))
+    return rows
+
+
+def parsed_arm_names() -> set:
+    """Arm names FaultPlan.from_env actually reads: the value of every
+    faults.py module constant whose identifier appears in from_env's
+    source."""
+    from faster_distributed_training_tpu.resilience import faults
+
+    src = inspect.getsource(faults.FaultPlan.from_env)
+    parsed: set = set()
+    for name, value in vars(faults).items():
+        if (isinstance(value, str) and _ARM.fullmatch(value)
+                and re.search(rf"\b{name}\b", src)):
+            parsed.add(value)
+    return parsed
+
+
+def check() -> list:
+    problems = []
+    referenced = source_arm_names()
+    documented = readme_arm_rows()
+    parsed = parsed_arm_names()
+
+    for name in sorted(referenced - documented):
+        problems.append(
+            f"{name} is referenced in source but has no row in "
+            f"README.md's fault-injection table — document the arm")
+    for name in sorted(referenced - parsed):
+        problems.append(
+            f"{name} is referenced in source but FaultPlan.from_env "
+            f"never reads it (no ENV_* constant of that value in its "
+            f"source) — the arm would arm nothing")
+    for name in sorted(documented - referenced):
+        problems.append(
+            f"README.md documents {name} but no source references it — "
+            f"stale table row after an arm rename/removal?")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        for p in problems:
+            print(f"[check_fault_arms] {p}")
+        print(f"[check_fault_arms] {len(problems)} problem(s)")
+        return 1
+    print(f"[check_fault_arms] OK: {len(source_arm_names())} fault arms "
+          f"all parsed by FaultPlan.from_env and documented in README.md")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

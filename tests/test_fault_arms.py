@@ -1,0 +1,79 @@
+"""Fault-arm drift lint wrapper (r24 satellite): tier-1 gate around
+scripts/check_fault_arms.py, so an ``FDT_FAULT_*`` chaos arm can never
+again be added without being BOTH parsed by ``FaultPlan.from_env`` (an
+unparsed arm injects nothing and a chaos test silently passes on the
+happy path) and documented in README.md's fault-injection table.
+
+Fast by construction: regex over source + one inspect.getsource, no
+jax program execution."""
+
+import os
+import sys
+
+import pytest
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+
+import check_fault_arms as lint  # noqa: E402
+
+
+class TestFaultArmRegistry:
+    def test_source_readme_and_parser_agree(self):
+        """THE gate: referenced ⊆ documented, referenced ⊆ parsed,
+        documented ⊆ referenced — any drift is a tier-1 failure."""
+        assert lint.check() == []
+
+    def test_r24_arms_present_everywhere(self):
+        """The three arms this PR adds are referenced, parsed AND
+        documented (the chaos matrix rides on them)."""
+        for arm in ("FDT_FAULT_NAN_AT_STEP",
+                    "FDT_FAULT_LOSS_SPIKE_AT_STEP",
+                    "FDT_FAULT_CORRUPT_SHARD"):
+            assert arm in lint.source_arm_names()
+            assert arm in lint.parsed_arm_names()
+            assert arm in lint.readme_arm_rows()
+
+    def test_undocumented_arm_is_flagged(self, tmp_path, monkeypatch):
+        """Drop one arm's row from a README copy: the lint must name
+        the now-undocumented arm.  (readme_arm_rows binds README as a
+        default arg, so patch the function, not the constant.)"""
+        victim = sorted(lint.parsed_arm_names())[0]
+        readme = tmp_path / "README.md"
+        readme.write_text("".join(
+            line for line in open(lint.README)
+            if victim not in line))
+        real = lint.readme_arm_rows
+        monkeypatch.setattr(lint, "readme_arm_rows",
+                            lambda path=str(readme): real(path))
+        problems = lint.check()
+        assert any(victim in p and "no row" in p for p in problems)
+
+    def test_unparsed_arm_is_flagged(self, monkeypatch):
+        """An arm referenced in source that FaultPlan.from_env never
+        reads would inject NOTHING — the lint's reason to exist.
+        Simulate by hiding one parsed constant from the parser view."""
+        victim = sorted(lint.parsed_arm_names())[0]
+        real = lint.parsed_arm_names
+        monkeypatch.setattr(
+            lint, "parsed_arm_names", lambda: real() - {victim})
+        problems = lint.check()
+        assert any(victim in p and "never reads" in p for p in problems)
+
+    def test_stale_readme_row_is_flagged(self, monkeypatch):
+        """A documented arm nothing references (rename residue) rots
+        the table — flagged from the other direction."""
+        real = lint.readme_arm_rows
+        monkeypatch.setattr(
+            lint, "readme_arm_rows",
+            lambda path=None: real() | {"FDT_FAULT_BOGUS_ARM"})
+        problems = lint.check()
+        assert any("FDT_FAULT_BOGUS_ARM" in p and "stale" in p
+                   for p in problems)
+
+    def test_main_exit_codes(self, capsys):
+        assert lint.main() == 0
+        out = capsys.readouterr().out
+        assert "OK" in out and "fault arms" in out

@@ -512,11 +512,14 @@ class TestPrefetchClose:
 
 
 class TestFiniteIsHostSide:
+    # r24 unified the loop's private _finite into the repo-wide
+    # sentinel.host_finite — the loop imports it, these pins follow it.
     def test_finite_on_host_floats(self):
-        from faster_distributed_training_tpu.train.loop import _finite
-        assert _finite(1.0) and _finite(np.float32(3.5))
-        assert not _finite(float("nan")) and not _finite(float("inf"))
-        assert not _finite(None) and not _finite("x")
+        from faster_distributed_training_tpu.train.loop import host_finite
+        assert host_finite(1.0) and host_finite(np.float32(3.5))
+        assert not host_finite(float("nan"))
+        assert not host_finite(float("inf"))
+        assert not host_finite(None) and not host_finite("x")
 
     def test_finite_does_not_call_jnp(self, monkeypatch):
         # the satellite's point: no device round-trip at the epoch
@@ -525,8 +528,8 @@ class TestFiniteIsHostSide:
         monkeypatch.setattr(jax.numpy, "isfinite",
                             lambda *_: (_ for _ in ()).throw(
                                 AssertionError("device sync!")))
-        assert loop_mod._finite(2.0)
-        assert not loop_mod._finite(float("nan"))
+        assert loop_mod.host_finite(2.0)
+        assert not loop_mod.host_finite(float("nan"))
 
 
 def test_dispatch_overhead_smoke():

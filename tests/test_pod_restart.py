@@ -1328,6 +1328,12 @@ class TestSimulatedPodEndToEnd:
             reference, _m = step(reference, batch)
         return state, (lambda st: step(st, batch)), reference
 
+    @pytest.mark.slow  # r24 budget diet: 15 s — the FAIL-marker /
+    # generation-agreement protocol keeps tier-1 coverage via
+    # TestSimulatedSlicePodEndToEnd::test_slice_kill_survivors_hold_rejoin_bitwise
+    # (same markers + restore-step agreement on the richer slice path)
+    # and kill-at-N bitwise resume stays pinned by test_mesh2d,
+    # test_pipeline, and test_sentinel's kill-mid-replay twin
     def test_killed_host_pod_restarts_same_generation_bitwise(
             self, program, tmp_path):
         """Kill host 1 at step 6: host 0 observes the FAIL marker, both

@@ -1,0 +1,493 @@
+"""Anomaly-sentinel tests (r24 tentpole): the deterministic bad-step
+guard, loss-spike rollback-and-quarantine, and stream CRC integrity.
+
+The ISSUE acceptance pins, all tier-1 on the 8-virtual-device CPU mesh
+(conftest):
+
+  * sentinel OFF adds NOTHING: the lowered HLO of a --sentinel none
+    fp32 program is byte-identical to the unguarded build (trace-time
+    Python gating, no is-finite residue);
+  * sentinel ON skip-at-N is BITWISE equal to never dispatching the
+    poisoned step: params/opt_state/rng untouched, step advanced,
+    metrics masked, bad_steps counted — on the host program, a (dp, tp)
+    mesh, a (dp, pp) pipeline program, and inside the K=4 fused scan;
+  * spike -> rollback -> quarantined replay is DETERMINISTIC (two
+    spiked runs land bitwise-equal) and survives a kill mid-replay;
+  * the chaos matrix composes: NaN guard + spike rollback in one run;
+  * a corrupt stream shard is quarantined-and-continued (rows remapped,
+    counter + durable ledger entry), never a crash.
+
+donate=False throughout — several train programs share this pytest
+process (the test_resilience.py precedent)."""
+
+import json
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from faster_distributed_training_tpu.config import TrainConfig
+from faster_distributed_training_tpu.models import Transformer
+from faster_distributed_training_tpu.parallel import make_mesh
+from faster_distributed_training_tpu.resilience import (GoodputTracker,
+                                                        build_resilience)
+from faster_distributed_training_tpu.resilience import faults as faults_mod
+from faster_distributed_training_tpu.resilience.sentinel import (
+    LossSpike, QuarantineLedger, Sentinel, SpikeDetector, host_finite)
+from faster_distributed_training_tpu.resilience.storage import build_backend
+from faster_distributed_training_tpu.train import (create_train_state,
+                                                   make_train_step)
+from faster_distributed_training_tpu.train.steps import make_fused_train_step
+
+_SILENT = lambda *_: None                                 # noqa: E731
+
+
+def _assert_tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _tiny(sentinel="none", seed=0):
+    """The resilience-suite tiny transformer (d16 cls), with the
+    sentinel mode as the only degree of freedom: guard/none programs
+    share state+batch bitwise so program-level diffs are the sentinel's
+    alone.  Plain (unscheduled) sgd: the sentinel verdicts below need a
+    state that stays FINITE on healthy steps."""
+    cfg = TrainConfig(model="transformer", dataset="agnews", num_classes=4,
+                      batch_size=4, seq_len=8, optimizer="sgd",
+                      precision="fp32", epochs=1, donate=False,
+                      sentinel=sentinel)
+    import optax
+    model = Transformer(n_class=4, vocab=32, n_layers=1, h=2, d_model=16,
+                        d_ff=32, d_hidden=16, maxlen=8)
+    state = create_train_state(model, optax.sgd(0.1),
+                               jnp.zeros((4, 8), jnp.int32),
+                               jax.random.PRNGKey(seed),
+                               init_kwargs={"train": True})
+    batch = {"tokens": np.random.default_rng(0).integers(
+                 0, 32, size=(4, 8)).astype(np.int32),
+             "label": np.arange(4, dtype=np.int32) % 4}
+    return cfg, state, batch
+
+
+# -- host-side units ------------------------------------------------------
+
+class TestHostFinite:
+    def test_finite_and_not(self):
+        assert host_finite(1.5) and host_finite(0.0)
+        assert not host_finite(float("nan"))
+        assert not host_finite(float("inf"))
+        assert not host_finite(None)
+        assert not host_finite("n/a")
+        assert host_finite(jnp.float32(2.0))
+        assert not host_finite(jnp.float32(np.nan))
+
+
+class TestSpikeDetector:
+    def test_min_history_gates_detection(self):
+        det = SpikeDetector(window=16, threshold=8.0, min_history=8)
+        # an early outlier passes: not enough history to judge it
+        for i in range(7):
+            assert not det.observe(1.0 + 0.01 * i)
+        assert not det.observe(1e6)      # 8th observation, history is 7
+        det.reset()
+        for i in range(8):
+            det.observe(1.0 + 0.01 * i)
+        assert det.observe(1e6)          # now the window can vote
+
+    def test_spiking_loss_not_absorbed_into_window(self):
+        det = SpikeDetector(window=16, threshold=8.0, min_history=8)
+        for i in range(8):
+            det.observe(1.0 + 0.01 * i)
+        assert det.observe(1e6)
+        # the spike was NOT appended: the very next spike still fires
+        # against the healthy window instead of a poisoned median
+        assert det.observe(1e6)
+
+    def test_nonfinite_ignored(self):
+        det = SpikeDetector(window=16, threshold=8.0, min_history=2)
+        det.observe(1.0)
+        det.observe(1.0)
+        assert not det.observe(float("nan"))
+        assert not det.observe(float("inf"))
+        # and neither entered the window (median still 1.0)
+        assert det.observe(1e6)
+
+    def test_mad_floor_on_flat_window(self):
+        # identical losses: MAD == 0, floored at 1e-3*|median| so any
+        # numeric jitter does not become a rollback storm
+        det = SpikeDetector(window=16, threshold=8.0, min_history=8)
+        for _ in range(8):
+            det.observe(1.0)
+        assert not det.observe(1.005)    # inside the floored band
+        assert det.observe(1.01)         # > 1.0 + 8 * 1e-3
+
+    def test_reset_clears_history(self):
+        det = SpikeDetector(window=16, threshold=8.0, min_history=4)
+        for _ in range(4):
+            det.observe(1.0)
+        det.reset()
+        assert not det.observe(1e6)      # history gone, gate re-armed
+
+
+class TestQuarantineLedger:
+    def test_in_memory_accumulates(self):
+        led = QuarantineLedger()
+        led.add_batches(1, [3, 5])
+        led.add_batches(1, [5, 7])
+        led.add_shard(2)
+        assert led.batches_for(1) == {3, 5, 7}
+        assert led.batches_for(0) == set()
+        assert led.shards() == {2}
+
+    def test_durable_roundtrip(self, tmp_path):
+        backend = build_backend("posix", str(tmp_path), log=_SILENT)
+        key = backend.join(str(tmp_path), "quarantine/ledger.json")
+        led = QuarantineLedger(backend=backend, key=key)
+        led.add_batches(1, [1])
+        led.add_shard(3)
+        # the flush is durable JSON a fresh process can reload
+        path = tmp_path / "quarantine" / "ledger.json"
+        assert path.exists()
+        doc = json.loads(path.read_text())
+        assert doc["version"] == 1
+        assert doc["batches"] == {"1": [1]} and doc["shards"] == [3]
+        # a fresh process (fresh backend object) reloads the identical
+        # quarantine set before its first dispatch
+        led2 = QuarantineLedger(backend=build_backend(
+            "posix", str(tmp_path), log=_SILENT), key=key)
+        assert led2.batches_for(1) == {1} and led2.shards() == {3}
+
+
+class TestSentinelHost:
+    def test_mode_validation(self):
+        with pytest.raises(ValueError, match="guard/full"):
+            Sentinel("none", log=_SILENT)
+        with pytest.raises(ValueError):
+            Sentinel("bogus", log=_SILENT)
+
+    def test_plan_fast_path_and_splits(self):
+        s = Sentinel("guard", log=_SILENT)
+        assert s.plan(0, 8, 4) == [(8, 4)]          # empty-ledger hot path
+        s.ledger.add_batches(0, [10])
+        assert s.plan(0, 8, 4) == [(8, 2), (11, 1)]
+        s.ledger.add_batches(0, [8, 9, 11])
+        assert s.plan(0, 8, 4) == []                # fully quarantined
+        assert s.plan(1, 8, 4) == [(8, 4)]          # other epochs untouched
+        assert s.quarantined(0, 10) and not s.quarantined(1, 10)
+
+    def test_observe_quarantines_counts_and_raises(self):
+        g = GoodputTracker().start()
+        s = Sentinel("full", goodput=g, log=_SILENT)
+        for i in range(9):
+            s.observe(0, i, 1, 1.0 + 0.01 * i, step=i + 1)
+        with pytest.raises(LossSpike) as ei:
+            s.observe(1, 1, 2, 1e6, step=10)
+        assert ei.value.epoch == 1 and ei.value.positions == (1, 2)
+        assert s.quarantined(1, 1) and s.quarantined(1, 2)
+        summ = g.summary()
+        assert summ["rollbacks"] == 1
+        assert summ["quarantined_batches"] == 2
+        # detector reset on spike: the replay's stream re-trains the
+        # window before it may vote again
+        assert not s.detector.observe(1e6)
+
+    def test_guard_mode_observe_is_noop(self):
+        s = Sentinel("guard", log=_SILENT)
+        assert s.detector is None
+        for i in range(20):
+            s.observe(0, i, 1, 1e6, step=i + 1)     # never raises
+
+    def test_quarantine_shard_warns_counts_never_raises(self):
+        g = GoodputTracker().start()
+        s = Sentinel("guard", goodput=g, log=_SILENT)
+        with pytest.warns(UserWarning, match="CRC"):
+            s.quarantine_shard(2, path="shard_00002/tokens.npy")
+        assert s.ledger.shards() == {2}
+        assert g.summary()["quarantined_shards"] == 1
+
+    def test_build_resilience_wires_sentinel(self, tmp_path):
+        cfg = TrainConfig(model="transformer", dataset="synthetic",
+                          num_classes=4, batch_size=8, seq_len=16,
+                          epochs=1, donate=False, sentinel="guard",
+                          checkpoint_dir=str(tmp_path))
+        res = build_resilience(cfg, log=_SILENT)
+        assert res is not None and res.sentinel is not None
+        assert res.sentinel.mode == "guard"
+        # the ledger key is rooted under checkpoint_dir — NOT the bare
+        # CWD-relative LEDGER_KEY (PosixBackend keys are paths verbatim;
+        # a restart from another directory must still find the ledger)
+        assert res.sentinel.ledger._key.startswith(str(tmp_path))
+
+
+# -- the in-graph guard ---------------------------------------------------
+
+class TestSentinelGraph:
+    """Program-level pins: OFF is byte-identical, ON skips bitwise."""
+
+    def test_sentinel_off_trace_is_byte_identical(self):
+        # fp32 --sentinel none must lower to the same text as the
+        # pre-sentinel build: no is-finite residue anywhere (the fp32
+        # unscale path returns a constant-True verdict)
+        cfg_none, state, batch = _tiny("none")
+        cfg_guard, _s, _b = _tiny("guard")
+        plain = jax.jit(make_train_step(cfg_none)).lower(
+            state, batch).as_text()
+        guard = jax.jit(make_train_step(cfg_guard)).lower(
+            state, batch).as_text()
+        assert "is_finite" not in plain
+        assert "is_finite" in guard
+        assert plain != guard
+
+    def _skip_parity(self, cfg_guard, cfg_none, state, batch, steps=4,
+                     nan_at=2, mesh=None, pipeline=None):
+        """Guarded run with NaN poison at state.step == nan_at vs the
+        unguarded program that simply never dispatches that step
+        (manual step bump) — bitwise equality is the skip contract."""
+        import contextlib
+        ctx = mesh if mesh is not None else contextlib.nullcontext()
+        with ctx:
+            step_g = jax.jit(make_train_step(cfg_guard, pipeline=pipeline))
+            s = state
+            bad, losses = 0.0, []
+            for _ in range(steps):
+                s, m = step_g(s, batch)
+                bad += float(m["bad_steps"])
+                losses.append(float(m["loss"]))
+        # reference: the sentinel-none program.  The NaN arm may still
+        # be baked into this trace (env armed) — harmless: the poisoned
+        # step counter is exactly the one this loop never dispatches
+        with ctx:
+            step_p = jax.jit(make_train_step(cfg_none, pipeline=pipeline))
+            r = state
+            for i in range(steps):
+                if i == nan_at:
+                    r = r.replace(step=r.step + 1)
+                    continue
+                r, _m = step_p(r, batch)
+        assert bad == 1.0
+        assert losses[nan_at] == 0.0            # masked, not NaN
+        assert all(np.isfinite(losses))
+        assert int(s.step) == int(r.step) == steps
+        _assert_tree_equal(s.params, r.params)
+        _assert_tree_equal(s.opt_state, r.opt_state)
+        np.testing.assert_array_equal(np.asarray(s.rng), np.asarray(r.rng))
+
+    def test_skip_at_n_bitwise_host(self, monkeypatch):
+        cfg_guard, state, batch = _tiny("guard")
+        monkeypatch.setenv(faults_mod.ENV_NAN, "2")   # read at TRACE time
+        cfg_none, _s, _b = _tiny("none")
+        self._skip_parity(cfg_guard, cfg_none, state, batch)
+
+    def test_skip_at_n_bitwise_dp_tp_mesh(self, monkeypatch,
+                                          requires_devices):
+        requires_devices(8)
+        cfg_guard, state, batch = _tiny("guard")
+        cfg_none, _s, _b = _tiny("none")
+        monkeypatch.setenv(faults_mod.ENV_NAN, "2")
+        mesh = make_mesh(("dp", "tp"), (4, 2), jax.devices()[:8])
+        self._skip_parity(cfg_guard, cfg_none, state, batch, mesh=mesh)
+
+    def test_skip_at_n_bitwise_dp_pp_mesh(self, monkeypatch,
+                                          requires_devices):
+        requires_devices(4)
+        import optax
+
+        from faster_distributed_training_tpu.cli import build_model
+        from faster_distributed_training_tpu.parallel.pipeline import (
+            build_pipeline_spec)
+        base = dict(model="transformer", dataset="synthetic", task="lm",
+                    batch_size=8, seq_len=16, n_layers=2, d_model=32,
+                    d_ff=64, n_heads=4, dropout_impl="none",
+                    optimizer="sgd", precision="fp32", donate=False,
+                    num_classes=4)
+        cfg_guard = TrainConfig(sentinel="guard", **base)
+        cfg_none = TrainConfig(**base)
+        mesh = make_mesh(("dp", "pp"), (2, 2), jax.devices()[:4])
+        spec = build_pipeline_spec(cfg_guard, mesh)
+        model = build_model(cfg_guard, vocab_size=100, mesh=None)
+        state = create_train_state(model, optax.sgd(0.1),
+                                   jnp.zeros((8, 16), jnp.int32),
+                                   jax.random.PRNGKey(0),
+                                   init_kwargs={"train": True})
+        batch = {"tokens": jax.random.randint(jax.random.PRNGKey(1),
+                                              (8, 16), 0, 100)}
+        monkeypatch.setenv(faults_mod.ENV_NAN, "2")
+        self._skip_parity(cfg_guard, cfg_none, state, batch,
+                          mesh=mesh, pipeline=spec)
+
+    def test_skip_inside_fused_k4_scan(self, monkeypatch):
+        """The poisoned step skips INSIDE the K-dispatch scan: the fused
+        K=4 dispatch with a NaN at scan step 2 lands bitwise on four
+        guarded K=1 steps, and its reduced metrics count bad_steps=1."""
+        cfg_guard, state, batch = _tiny("guard")
+        monkeypatch.setenv(faults_mod.ENV_NAN, "2")
+        batches = {k: np.stack([v] * 4) for k, v in batch.items()}
+        s4, m4 = jax.jit(make_fused_train_step(cfg_guard, 4))(state, batches)
+        step1 = jax.jit(make_train_step(cfg_guard))
+        s1, bad = state, 0.0
+        for _ in range(4):
+            s1, m1 = step1(s1, batch)
+            bad += float(m1["bad_steps"])
+        assert float(m4["bad_steps"]) == bad == 1.0
+        assert int(s4.step) == int(s1.step) == 4
+        _assert_tree_equal(s4.params, s1.params)
+        _assert_tree_equal(s4.opt_state, s1.opt_state)
+
+
+# -- e2e: spike -> rollback -> quarantined replay -------------------------
+
+def _e2e_cfg(tmp, **kw):
+    """Tiny REAL run_training config (the test_resilience.py twin):
+    synthetic AG News, 8 steps/epoch x 2 epochs = 16 global steps."""
+    base = dict(model="transformer", dataset="synthetic", num_classes=4,
+                batch_size=8, seq_len=16, n_layers=1, d_model=16, d_ff=32,
+                n_heads=2, epochs=2, subset_stride=64, optimizer="sgd",
+                precision="fp32", plot=False, workers=2, log_every=0,
+                donate=False, checkpoint_dir=str(tmp))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _spiked_run(tmp, extra_env=()):
+    """One full-sentinel run with the spike arm at step 10: epoch 1
+    position 1 spikes (9 healthy observations >= min_history), the
+    supervisor rolls back to the step-8 checkpoint and replays epoch 1
+    with that position quarantined — 7 replay dispatches, final step 15.
+
+    checkpoint_async=False: the rollback target is the newest COMMITTED
+    checkpoint, and an async commit frontier is a race against the step
+    loop — sync saves make the restore point (and with it the whole
+    replay trajectory) a pure function of the step sequence."""
+    from faster_distributed_training_tpu.cli import run_training
+    env = dict(extra_env)
+    env[faults_mod.ENV_SPIKE] = "10"
+    try:
+        for k, v in env.items():
+            os.environ[k] = v
+        return run_training(
+            # lr=0.01: the default-lr schedule genuinely diverges on
+            # this tiny run (loss ~47 at the epoch turn) and trips the
+            # detector on its own — the test wants the INJECTED spike
+            # to be the only anomaly in an otherwise-healthy stream
+            _e2e_cfg(tmp, sentinel="full", supervise=True,
+                     checkpoint_every=2, checkpoint_async=False,
+                     lr=0.01),
+            log=_SILENT)
+    finally:
+        for k in env:
+            os.environ.pop(k, None)
+
+
+class TestSpikeRollbackE2E:
+    @pytest.fixture(scope="class")
+    def spiked(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("spiked")
+        return _spiked_run(tmp), tmp
+
+    def test_spike_rolls_back_and_quarantines(self, spiked):
+        out, tmp = spiked
+        # one spike -> one rollback, one batch position quarantined, one
+        # restore through the supervisor's newest-VALID ladder; the run
+        # finishes one step short of 16 (the batch is gone, not retried)
+        assert out["goodput_rollbacks"] == 1
+        assert out["goodput_quarantined_batches"] == 1
+        assert out["goodput_restores"] == 1
+        assert int(out["state"].step) == 15
+
+    def test_ledger_is_durable_json(self, spiked):
+        _out, tmp = spiked
+        doc = json.loads(
+            (tmp / "quarantine" / "ledger.json").read_text())
+        assert doc["version"] == 1
+        assert doc["batches"] == {"1": [1]}      # epoch 1, position 1
+        assert doc["shards"] == []
+
+    def test_replay_is_deterministic(self, spiked, tmp_path):
+        # the whole ladder is pure (pod_epoch_order algebra + bitwise
+        # restore): a second spiked run reproduces the first bitwise
+        out1, _tmp = spiked
+        out2 = _spiked_run(tmp_path)
+        assert int(out2["state"].step) == 15
+        _assert_tree_equal(out1["state"].params, out2["state"].params)
+        _assert_tree_equal(out1["state"].opt_state, out2["state"].opt_state)
+        np.testing.assert_array_equal(np.asarray(out1["state"].rng),
+                                      np.asarray(out2["state"].rng))
+
+    def test_kill_mid_replay_resumes_bitwise(self, spiked, tmp_path):
+        """A crash DURING the quarantined replay (die at step 12; the
+        first pass ends at the step-10 spike, so only the replay reaches
+        12) restores by stored (epoch, position) and still lands bitwise
+        on the uninterrupted spiked run."""
+        out1, _tmp = spiked
+        out2 = _spiked_run(tmp_path,
+                           extra_env={faults_mod.ENV_DIE: "12"})
+        assert out2["goodput_rollbacks"] == 1
+        assert out2["goodput_restarts"] >= 1     # the injected crash
+        assert int(out2["state"].step) == 15
+        _assert_tree_equal(out1["state"].params, out2["state"].params)
+        _assert_tree_equal(out1["state"].opt_state, out2["state"].opt_state)
+        np.testing.assert_array_equal(np.asarray(out1["state"].rng),
+                                      np.asarray(out2["state"].rng))
+
+    def test_chaos_matrix_nan_plus_spike(self, tmp_path):
+        # both arms in one run: the in-graph guard eats the NaN step
+        # (skipped, counted), the spike ladder rolls back and replays —
+        # the run completes with both verdicts on the goodput surface
+        out = _spiked_run(tmp_path,
+                          extra_env={faults_mod.ENV_NAN: "4"})
+        assert int(out["state"].step) == 15
+        assert out["goodput_skipped_steps"] == 1
+        assert out["goodput_rollbacks"] == 1
+        assert out["goodput_quarantined_batches"] == 1
+
+    def test_nan_guard_only_no_supervisor(self, tmp_path, monkeypatch):
+        # --sentinel guard alone (no supervise, no checkpoints): the
+        # poisoned step is skipped in-graph and the run just finishes
+        from faster_distributed_training_tpu.cli import run_training
+        monkeypatch.setenv(faults_mod.ENV_NAN, "4")
+        out = run_training(_e2e_cfg(tmp_path, sentinel="guard"),
+                           log=_SILENT)
+        assert int(out["state"].step) == 16      # skip advances the step
+        assert out["goodput_skipped_steps"] == 1
+        assert out["goodput_rollbacks"] == 0
+
+
+# -- e2e: stream CRC quarantine ------------------------------------------
+
+class TestCorruptShardE2E:
+    def test_corrupt_shard_quarantined_run_completes(self, tmp_path,
+                                                     monkeypatch):
+        from faster_distributed_training_tpu.cli import run_training
+        from faster_distributed_training_tpu.data.stream import (
+            ShardedStreamDataset, synthetic_corpus, write_lm_corpus)
+        d = str(tmp_path / "corpus")
+        write_lm_corpus(d, synthetic_corpus(40, seed=3,
+                                            words_per_doc=(25, 50)),
+                        seq_len=16, rows_per_shard=16, val_fraction=0.15)
+        train = ShardedStreamDataset(os.path.join(d, "train"))
+        assert len(train.manifest["shards"]) > 1
+        cfg = TrainConfig(model="transformer", dataset="stream", task="lm",
+                          data_path="stream", stream_dir=d, batch_size=8,
+                          seq_len=16, n_layers=1, d_model=16, d_ff=32,
+                          n_heads=2, epochs=1, steps_per_dispatch=2,
+                          stream_window=4, optimizer="sgd",
+                          precision="fp32", plot=False, workers=0,
+                          log_every=0, donate=False, sentinel="guard",
+                          checkpoint_dir=str(tmp_path / "ckpt"))
+        monkeypatch.setenv(faults_mod.ENV_CORRUPT, "1")
+        with pytest.warns(UserWarning, match="CRC"):
+            out = run_training(cfg, log=_SILENT)
+        # the corruption was detected, quarantined, counted — and the
+        # run did FULL work: quarantined rows remap to a healthy shard
+        # (position-preserving), they are not dropped
+        assert out["goodput_quarantined_shards"] == 1
+        assert int(out["state"].step) == train.n // 8
+        doc = json.loads((tmp_path / "ckpt" / "quarantine" /
+                          "ledger.json").read_text())
+        assert doc["shards"] == [1]

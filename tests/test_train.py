@@ -461,6 +461,11 @@ class TestLegacyCheckpointMigration:
 
 
 class TestFusedFFNTraining:
+    @pytest.mark.slow  # r24 budget diet: 12 s — the pallas FFN kernel
+    # keeps tier-1 coverage at the layer it can break: fwd+grad parity
+    # vs the flax reference and multi-block grid/padding in test_ops,
+    # and shard_map-inside-pjit training composition via
+    # test_kernel_shard's quant e2e twins
     def test_fused_ffn_trains_on_8dev_mesh(self, devices8):
         """ffn_impl='pallas' through the REAL jitted train step on an
         8-way dp mesh: the shard_map-wrapped kernel must compile inside
@@ -523,6 +528,12 @@ class TestFailureRecovery:
         bad = {**good, "image": np.full((8, 32, 32, 3), np.nan, np.float32)}
         return cfg, state, good, bad, Trainer(cfg, log=lambda *_: None)
 
+    @pytest.mark.slow  # r24 budget diet: 16 s — the epoch-level NaN
+    # auto-recover loop stays tier-1 via test_gives_up_after_max_recoveries
+    # (same Trainer.fit recovery path, half the cost), and non-finite
+    # steps are now primarily caught PRE-commit by the in-graph sentinel
+    # guard (tests/test_sentinel.py skip-at-N bitwise pins + the
+    # FDT_FAULT_NAN_AT_STEP chaos arm through run_training)
     def test_recovers_from_nan_epoch(self, tmp_path):
         cfg, state, good, bad, trainer = self._trainer_setup(tmp_path)
 
